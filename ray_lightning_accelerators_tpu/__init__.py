@@ -1,0 +1,33 @@
+"""ray_lightning_accelerators_tpu: a TPU-native distributed training framework
+with the capability surface of `ray_lightning` (reference:
+ray_lightning/__init__.py:1-4 exports RayAccelerator + HorovodRayAccelerator).
+
+Public API adds the full trainer stack the reference borrowed from PTL, the
+`RayTPUAccelerator` north-star class, and the Tune-equivalent subsystem.
+"""
+
+from .accelerators.base import Accelerator
+from .accelerators.tpu import (HorovodRayAccelerator, RayAccelerator,
+                               RayTPUAccelerator)
+from .core.callbacks import Callback, EarlyStopping, ModelCheckpoint
+from .core.module import TpuModule
+from .core.state import TrainState
+from .core.trainer import Trainer
+from .data.datamodule import DataModule
+from .data.loader import (ArrayDataset, DataLoader, Dataset, RandomDataset,
+                          ShardedSampler)
+from .parallel.mesh import MeshConfig, build_mesh
+from .runtime.session import get_actor_rank, init_session, put_queue
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Accelerator", "RayAccelerator", "RayTPUAccelerator",
+    "HorovodRayAccelerator",
+    "Trainer", "TpuModule", "TrainState",
+    "Callback", "EarlyStopping", "ModelCheckpoint",
+    "DataModule", "DataLoader", "Dataset", "ArrayDataset", "RandomDataset",
+    "ShardedSampler",
+    "MeshConfig", "build_mesh",
+    "get_actor_rank", "init_session", "put_queue",
+]

@@ -1,0 +1,117 @@
+"""TpuModule: the user-facing model container.
+
+Capability analog of the reference's ``LightningModule`` usage (the reference
+keeps PTL's module untouched and asserts its contract through BoringModel,
+reference: ray_lightning/tests/utils.py:24-91).  TPU-native difference: the
+step methods are **pure functions of (params, batch)** so the trainer can
+trace them once under ``jax.jit`` and shard them over a mesh.  Attributes on
+``self`` are trace-time constants (hyperparameters, flax module defs) -- never
+per-step mutable state.
+
+Mapping from the reference's API:
+
+- ``self.log("k", v)`` inside a step  ->  return ``(loss, {"k": v})`` /
+  a metrics dict; the trainer routes it to loggers, callbacks and
+  ``trainer.callback_metrics`` exactly like PTL's ``callback_metrics`` bridge
+  the Tune callbacks harvested (reference: ray_lightning/tune.py:82-95).
+- ``configure_optimizers`` -> returns an ``optax.GradientTransformation``.
+- ``forward``/``__call__``  -> ``predict_step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+StepOutput = Union[jax.Array, Tuple[jax.Array, Dict[str, jax.Array]]]
+
+
+class TpuModule:
+    """Base class for user models."""
+
+    def __init__(self):
+        self.hparams: Dict[str, Any] = {}
+        self.params: Any = None          # populated by Trainer after fit()
+        self.trainer = None              # backref set by Trainer
+        self.compute_dtype = jnp.float32  # set from Trainer(precision=...)
+
+    # ------------------------------------------------------------------ #
+    # Methods the user overrides.                                        #
+    # ------------------------------------------------------------------ #
+    def init_params(self, rng: jax.Array) -> Any:
+        """Build and return the parameter pytree."""
+        raise NotImplementedError
+
+    def configure_optimizers(self) -> optax.GradientTransformation:
+        return optax.adam(1e-3)
+
+    def training_step(self, params: Any, batch: Any,
+                      rng: jax.Array) -> StepOutput:
+        """Return loss, or (loss, metrics-dict).  Must be jax-traceable."""
+        raise NotImplementedError
+
+    def validation_step(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        """Return a dict of per-batch metrics (means).  Jax-traceable."""
+        raise NotImplementedError
+
+    def test_step(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        return self.validation_step(params, batch)
+
+    def predict_step(self, params: Any, batch: Any) -> Any:
+        return self.forward(params, batch)
+
+    def forward(self, params: Any, batch: Any) -> Any:
+        raise NotImplementedError
+
+    def on_validation_epoch_end(self) -> None:
+        """Host-side hook after each validation pass (not traced)."""
+        pass
+
+    # Optional hooks mirroring PTL's checkpoint hooks (the reference's
+    # BoringModel persists a counter through these,
+    # reference: ray_lightning/tests/utils.py:87-91).
+    def on_save_checkpoint(self, checkpoint: Dict[str, Any]) -> None:
+        pass
+
+    def on_load_checkpoint(self, checkpoint: Dict[str, Any]) -> None:
+        pass
+
+    # ------------------------------------------------------------------ #
+    # Conveniences.                                                      #
+    # ------------------------------------------------------------------ #
+    def save_hyperparameters(self, **kwargs) -> None:
+        self.hparams.update(kwargs)
+
+    def __call__(self, batch: Any) -> Any:
+        """Eager convenience: run predict_step with the fitted params."""
+        if self.params is None:
+            raise RuntimeError(
+                "module has no params yet -- call trainer.fit() first or set "
+                ".params explicitly")
+        # cache the jitted wrapper: a fresh jax.jit per call would retrace
+        # (and recompile) every invocation
+        if not hasattr(self, "_jit_predict"):
+            self._jit_predict = jax.jit(self.predict_step)
+        return self._jit_predict(self.params, batch)
+
+    @classmethod
+    def load_from_checkpoint(cls, checkpoint_path: str,
+                             module: Optional["TpuModule"] = None,
+                             **init_kwargs) -> "TpuModule":
+        """Rebuild a module and install checkpointed params into it.
+
+        Capability analog of ``LightningModule.load_from_checkpoint``
+        (exercised by the reference's load_test,
+        reference: ray_lightning/tests/utils.py:129-134).
+        """
+        from ..utils import checkpoint as ckpt_lib
+        payload = ckpt_lib.read_checkpoint(checkpoint_path)
+        mod = module if module is not None else cls(**payload.get("hparams", init_kwargs) or init_kwargs)
+        rng = jax.random.PRNGKey(0)
+        template = mod.init_params(rng)
+        mod.params = ckpt_lib.restore_params(payload, template)
+        mod.on_load_checkpoint(payload)
+        return mod

@@ -1,0 +1,38 @@
+"""Training state: one immutable pytree holding everything a step mutates.
+
+The reference mutated a live ``Trainer``/``nn.Module`` in place inside each
+worker (reference: ray_lightning/ray_ddp.py:206-219).  Under XLA everything a
+step touches must flow through the traced function, so state is a single
+donated pytree: params, optimizer state, step counter, PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array            # scalar int32 global step
+    params: Any                # model parameter pytree
+    opt_state: Any             # optax state pytree
+    rng: jax.Array             # base PRNG key; per-step keys are fold_in(step)
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation,
+               rng: jax.Array) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng,
+        )
+
+    @property
+    def num_params(self) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(self.params))

@@ -1,0 +1,23 @@
+"""DataModule: bundles train/val/test loaders (LightningDataModule analog,
+as consumed by the reference examples via plain DataLoaders,
+reference: examples/ray_ddp_example.py:44-59)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .loader import DataLoader
+
+
+class DataModule:
+    def setup(self, stage: str) -> None:
+        pass
+
+    def train_dataloader(self) -> Optional[DataLoader]:
+        return None
+
+    def val_dataloader(self) -> Optional[DataLoader]:
+        return None
+
+    def test_dataloader(self) -> Optional[DataLoader]:
+        return None

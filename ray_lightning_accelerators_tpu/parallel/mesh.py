@@ -1,0 +1,132 @@
+"""Device-mesh construction and axis conventions.
+
+TPU-native replacement for the reference's process-group topology handling
+(reference: ray_lightning/ray_ddp.py:132-143 derives a global->local rank map
+from a Ray node-IP census; ray_lightning/ray_horovod.py:84-85 exposes a
+hosts x slots topology).  Here topology is a first-class
+``jax.sharding.Mesh`` over named axes, and parallelism strategies are
+expressed as axis sizes instead of process counts:
+
+- ``data``     -- pure data parallelism (gradient psum over this axis).
+- ``fsdp``     -- data parallelism + parameter/optimizer sharding (ZeRO-3).
+- ``tensor``   -- tensor (megatron-style) model parallelism.
+- ``sequence`` -- sequence/context parallelism (ring attention rides here).
+- ``pipeline`` -- pipeline-stage axis.
+- ``expert``   -- MoE expert axis.
+
+XLA inserts the collectives (psum / all-gather / reduce-scatter / ppermute)
+from sharding annotations; nothing here opens sockets or manages NCCL-style
+communicators.  Multi-host meshes use the same API: `jax.devices()` already
+spans all processes after `jax.distributed.initialize`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, outermost (slowest-varying, DCN-friendly) first.
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+PIPELINE_AXIS = "pipeline"
+SEQUENCE_AXIS = "sequence"
+TENSOR_AXIS = "tensor"
+EXPERT_AXIS = "expert"
+
+# The order matters: outer axes see the slowest interconnect (DCN between
+# hosts), inner axes the fastest (ICI neighbours).  Tensor parallelism wants
+# the fastest links, data parallelism tolerates the slowest -- so `data` is
+# outermost and `tensor` innermost.
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+
+# Axes over which a global batch is split.  Both plain DP and FSDP shard the
+# batch dimension; this tuple is the PartitionSpec entry for batch dim 0.
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each mesh axis.  ``-1`` on `data` means "all remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    pipeline: int = 1
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def axis_sizes(self, num_devices: int) -> dict:
+        sizes = {
+            DATA_AXIS: self.data,
+            FSDP_AXIS: self.fsdp,
+            PIPELINE_AXIS: self.pipeline,
+            EXPERT_AXIS: self.expert,
+            SEQUENCE_AXIS: self.sequence,
+            TENSOR_AXIS: self.tensor,
+        }
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        n_infer = sum(1 for v in sizes.values() if v == -1)
+        if n_infer > 1:
+            raise ValueError("at most one mesh axis may be -1 (inferred)")
+        if n_infer == 1:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"cannot infer axis size: {num_devices} devices not divisible "
+                    f"by fixed product {fixed}")
+            for k, v in sizes.items():
+                if v == -1:
+                    sizes[k] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f"mesh axes multiply to {fixed} but {num_devices} devices are "
+                f"available")
+        return sizes
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Construct a named Mesh over `devices` (default: all devices).
+
+    Devices are laid out so that consecutive devices (fast ICI neighbours)
+    land on the innermost axes.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = config.axis_sizes(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return build_mesh(MeshConfig(data=1), [device])
+
+
+def batch_spec(extra_dims: int = 0) -> P:
+    """PartitionSpec for a [batch, ...] array: batch split over (data, fsdp)."""
+    return P(BATCH_AXES, *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(BATCH_AXES))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """Number of batch shards (the DDP ``world_size`` analog)."""
+    return mesh_axis_size(mesh, DATA_AXIS) * mesh_axis_size(mesh, FSDP_AXIS)

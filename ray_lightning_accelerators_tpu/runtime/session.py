@@ -1,0 +1,70 @@
+"""Per-worker session: rank + driver-queue singleton.
+
+Direct capability analog of the reference's session module
+(reference: ray_lightning/session.py:6-63): a process-global singleton giving
+worker-side code (callbacks) its global rank and a channel to ship callables
+to the driver -- the "callable trampoline" that makes Tune reporting work
+from inside workers (reference: ray_lightning/tune.py:97-101 ->
+session.py:61-63).
+
+In the TPU framework the "worker" is a per-host process (SPMD: often just
+one); the session is initialized by the trainer/runtime and by tune trials.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class TpuSession:
+    def __init__(self, rank: int, queue: Optional[Any] = None):
+        self._rank = rank
+        self._queue = queue
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    def put_queue(self, item: Callable[[], Any]) -> None:
+        if self._queue is None:
+            raise ValueError(
+                "this session has no queue attached -- it was not launched "
+                "under a driver that drains one (e.g. tune.run)")
+        self._queue.put((self._rank, item))
+
+
+_session: Optional[TpuSession] = None
+
+
+def init_session(rank: int, queue: Optional[Any] = None) -> None:
+    global _session
+    if _session is not None:
+        raise ValueError("a session already exists in this process; "
+                         "call shutdown_session() first")
+    _session = TpuSession(rank, queue)
+
+
+def get_session() -> TpuSession:
+    if _session is None:
+        raise ValueError("no session initialized in this process")
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    _session = None
+
+
+def session_exists() -> bool:
+    return _session is not None
+
+
+def get_actor_rank() -> int:
+    """Rank of this worker process (reference: session.py:56-58)."""
+    return get_session().rank
+
+
+def put_queue(item: Callable[[], Any]) -> None:
+    """Ship a zero-arg callable to the driver process for execution there
+    (reference: session.py:61-63)."""
+    get_session().put_queue(item)

@@ -1,0 +1,74 @@
+"""Checkpoint IO: atomic single-file checkpoints of full trainer state.
+
+Capability analog of the reference's two checkpoint paths: per-worker PTL
+``ModelCheckpoint`` files whose rank-0 path is shipped home (reference:
+ray_lightning/ray_ddp.py:269-278) and the Tune bridge's
+``dump_checkpoint`` + ``atomic_save`` (reference: ray_lightning/tune.py:128-142).
+
+TPU-native notes: every array is pulled to host (``jax.device_get``) before
+serialization -- device arrays may be sharded across a mesh and must be
+materialized; this is the XLA analog of the reference's implicit
+``state_dict()`` CPU copy.  Writes are atomic (tmp + rename) so a crashed
+writer never leaves a torn checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict
+
+import flax.serialization
+import jax
+
+
+def _to_host_state_dict(tree: Any) -> Any:
+    return flax.serialization.to_state_dict(jax.device_get(tree))
+
+
+def atomic_save(payload: Dict[str, Any], filepath: str) -> None:
+    """Pickle `payload` to `filepath` atomically."""
+    directory = os.path.dirname(os.path.abspath(filepath))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, filepath)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def build_checkpoint(state, epoch: int, global_step: int,
+                     hparams: Dict[str, Any] | None = None,
+                     callbacks: Dict[str, Any] | None = None,
+                     extra: Dict[str, Any] | None = None) -> Dict[str, Any]:
+    payload = {
+        "format_version": 1,
+        "state": _to_host_state_dict(state),
+        "epoch": int(epoch),
+        "global_step": int(global_step),
+        "hparams": dict(hparams or {}),
+        "callbacks": dict(callbacks or {}),
+    }
+    if extra:
+        payload.update(extra)
+    return payload
+
+
+def read_checkpoint(filepath: str) -> Dict[str, Any]:
+    with open(filepath, "rb") as f:
+        return pickle.load(f)
+
+
+def restore_state(payload: Dict[str, Any], template_state):
+    """Restore a TrainState pytree from a checkpoint payload."""
+    return flax.serialization.from_state_dict(template_state, payload["state"])
+
+
+def restore_params(payload: Dict[str, Any], template_params):
+    return flax.serialization.from_state_dict(template_params,
+                                              payload["state"]["params"])

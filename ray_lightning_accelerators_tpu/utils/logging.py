@@ -1,0 +1,67 @@
+"""Loggers: metric sinks for the trainer.
+
+The reference delegated logging to PTL and only bridged
+``trainer.callback_metrics`` to Tune (reference: ray_lightning/tune.py:82-95).
+Here loggers are first-class: CSV on disk by default, an in-memory logger for
+tests.  All values arriving here are host floats -- the trainer is responsible
+for materializing device arrays at log boundaries only (never per step),
+keeping the XLA pipeline async.
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+import os
+from typing import Dict, List, Optional
+
+log = logging.getLogger("ray_lightning_accelerators_tpu")
+if not log.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[%(levelname)s rla-tpu] %(message)s"))
+    log.addHandler(_h)
+    log.setLevel(os.environ.get("RLA_TPU_LOG_LEVEL", "WARNING"))
+
+
+class Logger:
+    def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> None:
+        pass
+
+
+class InMemoryLogger(Logger):
+    def __init__(self):
+        self.history: List[Dict[str, float]] = []
+
+    def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
+        row = dict(metrics)
+        row["step"] = step
+        self.history.append(row)
+
+
+class CSVLogger(Logger):
+    """Append-only metrics.csv under `save_dir` (schema grows as keys appear)."""
+
+    def __init__(self, save_dir: str, name: str = "metrics.csv"):
+        self.save_dir = save_dir
+        self.path = os.path.join(save_dir, name)
+        self._rows: List[Dict[str, float]] = []
+        self._keys: List[str] = ["step"]
+
+    def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
+        row = {"step": step, **metrics}
+        for k in row:
+            if k not in self._keys:
+                self._keys.append(k)
+        self._rows.append(row)
+
+    def finalize(self) -> None:
+        if not self._rows:
+            return
+        os.makedirs(self.save_dir, exist_ok=True)
+        with open(self.path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=self._keys)
+            writer.writeheader()
+            writer.writerows(self._rows)

@@ -1,0 +1,37 @@
+"""Deterministic seeding across driver and workers.
+
+Parity with the reference's ``PL_GLOBAL_SEED`` propagation into every Ray
+actor (reference: ray_lightning/ray_ddp.py:154-159).  We honor both that
+variable and our own, and return a jax PRNG key -- the TPU-native seed object.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+import jax
+import numpy as np
+
+SEED_ENV_VARS = ("RLA_TPU_GLOBAL_SEED", "PL_GLOBAL_SEED")
+
+
+def seed_everything(seed: Optional[int] = None) -> int:
+    """Seed python/numpy RNGs, export the seed for child processes."""
+    if seed is None:
+        for var in SEED_ENV_VARS:
+            if os.environ.get(var):
+                seed = int(os.environ[var])
+                break
+        else:
+            seed = 0
+    random.seed(seed)
+    np.random.seed(seed % (2 ** 32))
+    for var in SEED_ENV_VARS:
+        os.environ[var] = str(seed)
+    return seed
+
+
+def rng_from_seed(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)

@@ -1,0 +1,15 @@
+"""Packaging (capability parity with reference setup.py:1-12)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="ray_lightning_accelerators_tpu",
+    packages=find_packages(include=["ray_lightning_accelerators_tpu",
+                                    "ray_lightning_accelerators_tpu.*"]),
+    version="0.1.0",
+    description="TPU-native distributed training accelerators with a "
+                "Lightning-shaped trainer, mesh parallelism, and a Tune-style "
+                "hyperparameter search subsystem",
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "numpy"],
+)

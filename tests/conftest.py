@@ -1,0 +1,25 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE any backend init.
+
+The reference tested multi-worker logic by CPU oversubscription on localhost
+with the Gloo backend (reference: ray_lightning/tests/test_ddp.py:17-21 +
+ray_ddp.py:227).  The XLA analog: 8 virtual CPU devices, so every
+mesh/sharding path runs in CI without TPUs; real-TPU runs are env-gated the
+way the reference gated GPU tests (reference: tests/test_ddp_gpu.py:106-109)
+via RLA_TPU_TEST_PLATFORM=tpu.
+
+Note: a TPU plugin loaded from sitecustomize may force `jax_platforms` via
+config (not env), so we override the config explicitly after import.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+_platform = os.environ.get("RLA_TPU_TEST_PLATFORM", "cpu")
+jax.config.update("jax_platforms", _platform)
+if _platform == "cpu":
+    jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,128 @@
+"""Trainer loop behavior: checkpoint/resume, callbacks, metrics, precision,
+grad accumulation — the surface the reference inherited from PTL and its
+tests pinned (SURVEY.md §2.2)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (EarlyStopping, ModelCheckpoint,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.utils.logging import InMemoryLogger
+
+from .utils import BoringModel, boring_loaders, get_trainer
+
+
+def test_max_steps_stops_early(tmpdir):
+    trainer = get_trainer(tmpdir, RayTPUAccelerator(2), max_epochs=100,
+                          max_steps=5)
+    train, val = boring_loaders()
+    trainer.fit(BoringModel(), train, val)
+    assert trainer.global_step == 5
+
+
+def test_callback_metrics_bridge(tmpdir):
+    """train + val metrics must land in callback_metrics as host floats
+    (the bridge Tune harvested, reference: ray_lightning/tune.py:82-95)."""
+    trainer = get_trainer(tmpdir, RayTPUAccelerator(2))
+    train, val = boring_loaders()
+    trainer.fit(BoringModel(), train, val)
+    assert isinstance(trainer.callback_metrics["val_loss"], float)
+    assert trainer.callback_metrics["val_loss"] == 1.0
+    assert "loss" in trainer.callback_metrics
+
+
+def test_checkpoint_resume(tmpdir):
+    """Mid-run checkpoint restores step/epoch/params exactly."""
+    model = BoringModel()
+    trainer = get_trainer(tmpdir, RayTPUAccelerator(2), max_epochs=2)
+    train, val = boring_loaders()
+    trainer.fit(model, train, val)
+    ckpt = os.path.join(str(tmpdir), "mid.ckpt")
+    trainer.save_checkpoint(ckpt)
+    params_before = jax.device_get(trainer._state.params)
+
+    model2 = BoringModel()
+    trainer2 = get_trainer(tmpdir, RayTPUAccelerator(2), max_epochs=4)
+    trainer2.fit(model2, train, val, ckpt_path=ckpt)
+    assert trainer2.current_epoch == 4
+    assert model2.val_epoch >= model.val_epoch  # module state restored + grew
+    # resumed run started from the saved params, not fresh init
+    fresh = model2.init_params(jax.random.PRNGKey(0))
+    saved_norm = sum(float(jnp.abs(a).sum())
+                     for a in jax.tree.leaves(params_before))
+    fresh_norm = sum(float(jnp.abs(a).sum()) for a in jax.tree.leaves(fresh))
+    assert abs(saved_norm - fresh_norm) > 1e-3
+
+
+def test_model_checkpoint_top_k(tmpdir):
+    class DecreasingVal(BoringModel):
+        def __init__(self):
+            super().__init__()
+            self._val = 10.0
+
+        def validation_step(self, params, batch):
+            return {"val_loss": jnp.asarray(self._val)}
+
+        def on_validation_epoch_end(self):
+            super().on_validation_epoch_end()
+            self._val -= 1.0
+
+    cb = ModelCheckpoint(monitor="val_loss", save_top_k=2)
+    trainer = get_trainer(tmpdir, RayTPUAccelerator(1), max_epochs=4,
+                          callbacks=[cb])
+    train, val = boring_loaders()
+    model = DecreasingVal()
+    trainer.fit(model, train, val)
+    assert cb.best_model_path and os.path.exists(cb.best_model_path)
+    saved = [p for _, p in cb._saved]
+    assert len(saved) == 2 and all(os.path.exists(p) for p in saved)
+
+
+def test_logger_receives_metrics(tmpdir):
+    logger = InMemoryLogger()
+    trainer = Trainer(default_root_dir=str(tmpdir), max_epochs=1,
+                      accelerator=RayTPUAccelerator(2), logger=logger,
+                      log_every_n_steps=2, precision="f32",
+                      limit_train_batches=8, seed=0)
+    train, val = boring_loaders()
+    trainer.fit(BoringModel(), train, val)
+    assert any("train_loss" in row for row in logger.history)
+    assert any("val_loss" in row for row in logger.history)
+
+
+def test_grad_accumulation(tmpdir):
+    trainer = Trainer(default_root_dir=str(tmpdir), max_epochs=1,
+                      accelerator=RayTPUAccelerator(2),
+                      accumulate_grad_batches=2, precision="f32", seed=0)
+    train, val = boring_loaders()
+    model = BoringModel()
+    trainer.fit(model, train, val)
+    assert model.params is not None
+
+
+def test_gradient_clipping(tmpdir):
+    trainer = Trainer(default_root_dir=str(tmpdir), max_epochs=1,
+                      accelerator=RayTPUAccelerator(2),
+                      gradient_clip_val=0.01, precision="f32", seed=0)
+    train, val = boring_loaders()
+    trainer.fit(BoringModel(), train, val)
+
+
+def test_bf16_precision_flag(tmpdir):
+    trainer = Trainer(default_root_dir=str(tmpdir), max_epochs=1,
+                      accelerator=RayTPUAccelerator(2), precision="bf16",
+                      seed=0)
+    model = BoringModel()
+    train, val = boring_loaders()
+    trainer.fit(model, train, val)
+    assert model.compute_dtype == jnp.bfloat16
+
+
+def test_seed_env_propagation(tmpdir):
+    get_trainer(tmpdir, RayTPUAccelerator(1), callbacks=[])
+    assert os.environ.get("PL_GLOBAL_SEED") == "0"
+    assert os.environ.get("RLA_TPU_GLOBAL_SEED") == "0"

@@ -1,0 +1,182 @@
+"""Shared fixtures: behavioral ports of the reference's test harness
+(reference: ray_lightning/tests/utils.py — BoringModel :24-91, get_trainer
+:94-114, train_test :117-126, load_test :129-134, predict_test :137-152)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                            DataModule, ModelCheckpoint,
+                                            RandomDataset, Trainer, TpuModule)
+
+
+class BoringModel(TpuModule):
+    """1-linear-layer model whose loss really moves weights, with a constant
+    val_loss=1.0 and a val_epoch counter persisted through checkpoint hooks
+    (mirrors reference BoringModel semantics)."""
+
+    def __init__(self):
+        super().__init__()
+        self.val_epoch = 0
+
+    def init_params(self, rng):
+        k = jax.random.normal(rng, (32, 2), jnp.float32) * 0.5
+        return {"layer": {"kernel": k, "bias": jnp.zeros((2,), jnp.float32)}}
+
+    def forward(self, params, x):
+        return x @ params["layer"]["kernel"] + params["layer"]["bias"]
+
+    def training_step(self, params, batch, rng):
+        out = self.forward(params, batch)
+        loss = jnp.mean((out - 1.0) ** 2)
+        return loss, {"loss": loss}
+
+    def validation_step(self, params, batch):
+        self.forward(params, batch)
+        return {"val_loss": jnp.asarray(1.0)}
+
+    def test_step(self, params, batch):
+        out = self.forward(params, batch)
+        return {"y": jnp.mean((out - 1.0) ** 2)}
+
+    def on_validation_epoch_end(self):
+        self.val_epoch += 1
+
+    def configure_optimizers(self):
+        return optax.sgd(0.1)
+
+    def on_save_checkpoint(self, checkpoint):
+        checkpoint["val_epoch"] = self.val_epoch
+
+    def on_load_checkpoint(self, checkpoint):
+        self.val_epoch = checkpoint.get("val_epoch", self.val_epoch)
+
+
+def boring_loaders(batch_size: int = 8):
+    train = DataLoader(RandomDataset(32, 64), batch_size=batch_size,
+                       shuffle=True)
+    val = DataLoader(RandomDataset(32, 64), batch_size=batch_size)
+    return train, val
+
+
+class BlobsDataModule(DataModule):
+    """Linearly separable 4-class blobs: the synthetic stand-in for the
+    reference's MNIST accuracy gate (no dataset downloads in this env)."""
+
+    def __init__(self, n: int = 512, dim: int = 32, classes: int = 4,
+                 batch_size: int = 16, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        centers = rng.standard_normal((classes, dim)).astype(np.float32) * 4.0
+        y = rng.integers(0, classes, size=n)
+        x = centers[y] + rng.standard_normal((n, dim)).astype(np.float32)
+        split = int(n * 0.75)
+        self._train = (x[:split], y[:split].astype(np.int32))
+        self._test = (x[split:], y[split:].astype(np.int32))
+        self.batch_size = batch_size
+
+    def train_dataloader(self):
+        return DataLoader(ArrayDataset(*self._train),
+                          batch_size=self.batch_size, shuffle=True)
+
+    def val_dataloader(self):
+        return DataLoader(ArrayDataset(*self._test),
+                          batch_size=self.batch_size)
+
+    def test_dataloader(self):
+        return DataLoader(ArrayDataset(*self._test),
+                          batch_size=self.batch_size, drop_last=False)
+
+
+class LinearClassifier(TpuModule):
+    def __init__(self, dim: int = 32, classes: int = 4, lr: float = 0.05):
+        super().__init__()
+        self.save_hyperparameters(dim=dim, classes=classes, lr=lr)
+        self.dim, self.classes, self.lr = dim, classes, lr
+
+    def init_params(self, rng):
+        return {"w": jax.random.normal(rng, (self.dim, self.classes)) * 0.01,
+                "b": jnp.zeros((self.classes,))}
+
+    def forward(self, params, x):
+        return x @ params["w"] + params["b"]
+
+    def _loss(self, params, batch):
+        x, y = batch
+        logits = self.forward(params, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        return loss, acc
+
+    def training_step(self, params, batch, rng):
+        loss, acc = self._loss(params, batch)
+        return loss, {"loss": loss, "acc": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._loss(params, batch)
+        return {"val_loss": loss, "val_accuracy": acc}
+
+    def predict_step(self, params, batch):
+        x = batch[0] if isinstance(batch, tuple) else batch
+        return self.forward(params, x)
+
+    def configure_optimizers(self):
+        return optax.adam(self.lr)
+
+
+def get_trainer(dir, accelerator, max_epochs: int = 1,
+                limit_train_batches: int = 10, limit_val_batches: int = 10,
+                callbacks=None, **kwargs) -> Trainer:
+    callbacks = list(callbacks or [])
+    if not any(isinstance(c, ModelCheckpoint) for c in callbacks):
+        callbacks.append(ModelCheckpoint(monitor="val_loss"))
+    return Trainer(default_root_dir=str(dir), max_epochs=max_epochs,
+                   limit_train_batches=limit_train_batches,
+                   limit_val_batches=limit_val_batches,
+                   accelerator=accelerator, callbacks=callbacks,
+                   precision="f32", seed=0, **kwargs)
+
+
+def _abs_sums(params):
+    return np.array([float(jnp.sum(jnp.abs(x)))
+                     for x in jax.tree.leaves(params)])
+
+
+def train_test(trainer, model, train_loader=None, val_loader=None):
+    """Weights must actually change after fit (reference: utils.py:117-126)."""
+    if train_loader is None:
+        train_loader, val_loader = boring_loaders()
+    initial = _abs_sums(model.init_params(jax.random.PRNGKey(trainer.seed)))
+    trainer.fit(model, train_loader, val_loader)
+    post = _abs_sums(model.params)
+    assert model.params is not None, "trainer failed"
+    assert np.linalg.norm(initial - post) > 0.1, \
+        "model unchanged post-training"
+
+
+def load_test(trainer, model, cls=BoringModel):
+    """Best-checkpoint round trip (reference: utils.py:129-134)."""
+    train_loader, val_loader = boring_loaders()
+    trainer.fit(model, train_loader, val_loader)
+    best = trainer.checkpoint_callback.best_model_path
+    assert best, "no best_model_path recorded"
+    trained = cls.load_from_checkpoint(best)
+    assert trained is not None and trained.params is not None
+
+
+def predict_test(trainer, model, dm):
+    """Trained accuracy >= 0.5 on held-out data (reference: utils.py:137-152)."""
+    trainer.fit(model, datamodule=dm)
+    dm.setup("test")
+    correct, total = 0, 0
+    for batch in dm.test_dataloader():
+        x, y = batch
+        y_hat = np.asarray(model((x, y)))
+        correct += int((y_hat.argmax(-1) == y).sum())
+        total += len(y)
+    acc = correct / total
+    assert acc >= 0.5, f"expected accuracy >= 0.5, got {acc}"
