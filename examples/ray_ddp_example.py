@@ -1,0 +1,77 @@
+"""MNIST training example (capability parity with the reference's DDP example,
+reference: examples/ray_ddp_example.py:61-168 -- same CLI flags, train or
+tune entry, smoke mode).  TPU-native: the accelerator shards a global batch
+over the device mesh instead of spawning DDP actors."""
+
+import argparse
+import os
+import tempfile
+
+from ray_lightning_accelerators_tpu import (RayTPUAccelerator, Trainer,
+                                            TuneReportCallback, tune)
+from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
+                                                         MNISTDataModule)
+
+
+def train_mnist(config, num_epochs=10, num_workers=1, callbacks=None,
+                data_dir=None, smoke=False):
+    model = MNISTClassifier(config, data_dir)
+    dm = MNISTDataModule(batch_size=config["batch_size"],
+                         n_train=2048 if smoke else 55000,
+                         n_val=512 if smoke else 5000)
+    trainer = Trainer(max_epochs=num_epochs,
+                      callbacks=list(callbacks or []),
+                      accelerator=RayTPUAccelerator(num_workers=num_workers),
+                      default_root_dir=os.path.join(tempfile.gettempdir(),
+                                                    "rla_tpu_mnist"),
+                      enable_progress_bar=True)
+    trainer.fit(model, datamodule=dm)
+    return trainer
+
+
+def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False):
+    config = {
+        "layer_1": tune.choice([32, 64, 128]),
+        "layer_2": tune.choice([64, 128, 256]),
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "batch_size": tune.choice([32, 64, 128]),
+    }
+    metrics = {"loss": "ptl/val_loss", "acc": "ptl/val_accuracy"}
+    callbacks = [TuneReportCallback(metrics, on="validation_end")]
+    analysis = tune.run(
+        lambda cfg: train_mnist(cfg, num_epochs, num_workers, callbacks,
+                                smoke=smoke),
+        config=config, num_samples=num_samples,
+        metric="loss", mode="min",
+        resources_per_trial={"cpu": 1, "extra_cpu": num_workers},
+        name="tune_mnist")
+    print("Best hyperparameters found were:", analysis.best_config)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1,
+                        help="Number of data-parallel shards (devices).")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--num-samples", type=int, default=10,
+                        help="Tune trials.")
+    parser.add_argument("--use-gpu", action="store_true",
+                        help="Accepted for reference parity; ignored on TPU.")
+    parser.add_argument("--tune", action="store_true")
+    parser.add_argument("--smoke-test", action="store_true")
+    parser.add_argument("--address", type=str, default=None,
+                        help="Coordinator address for multi-host runs.")
+    args = parser.parse_args()
+
+    if args.smoke_test:
+        args.num_epochs, args.num_samples = 1, 1
+
+    if args.tune:
+        tune_mnist(args.num_samples, args.num_epochs, args.num_workers,
+                   smoke=args.smoke_test)
+    else:
+        config = {"layer_1": 128, "layer_2": 256, "lr": 1e-3,
+                  "batch_size": 128}
+        trainer = train_mnist(config, args.num_epochs, args.num_workers,
+                              smoke=args.smoke_test)
+        print("final metrics:", trainer.callback_metrics)

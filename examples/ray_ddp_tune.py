@@ -1,0 +1,65 @@
+"""Tune-only MNIST example with a per-run init_hook (capability parity with
+reference examples/ray_ddp_tune.py:17-125, whose init_hook FileLock-downloads
+the dataset on every node :24-39)."""
+
+import argparse
+import os
+import tempfile
+
+from filelock import FileLock
+
+from ray_lightning_accelerators_tpu import (RayTPUAccelerator, Trainer,
+                                            TuneReportCallback, tune)
+from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
+                                                         MNISTDataModule)
+
+DATA_SENTINEL = os.path.join(tempfile.gettempdir(), "rla_tpu_mnist_ready")
+
+
+def prepare_data():
+    """Runs once per process under a lock -- the init_hook exemplar."""
+    with FileLock(DATA_SENTINEL + ".lock"):
+        if not os.path.exists(DATA_SENTINEL):
+            open(DATA_SENTINEL, "w").write("ok")
+
+
+def train_mnist(config, num_epochs=10, num_workers=1, smoke=False):
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=config["batch_size"],
+                         n_train=2048 if smoke else 55000,
+                         n_val=512 if smoke else 5000)
+    metrics = {"loss": "ptl/val_loss", "acc": "ptl/val_accuracy"}
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        callbacks=[TuneReportCallback(metrics, on="validation_end")],
+        accelerator=RayTPUAccelerator(num_workers=num_workers,
+                                      init_hook=prepare_data),
+        default_root_dir=os.path.join(tempfile.gettempdir(), "rla_tpu_tune"))
+    trainer.fit(model, datamodule=dm)
+
+
+def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False):
+    config = {
+        "layer_1": tune.choice([32, 64, 128]),
+        "layer_2": tune.choice([64, 128, 256]),
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "batch_size": tune.choice([32, 64, 128]),
+    }
+    analysis = tune.run(
+        lambda cfg: train_mnist(cfg, num_epochs, num_workers, smoke),
+        config=config, num_samples=num_samples, metric="loss", mode="min",
+        name="tune_mnist")
+    print("Best hyperparameters found were:", analysis.best_config)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--num-samples", type=int, default=10)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    if args.smoke_test:
+        args.num_epochs, args.num_samples = 1, 1
+    tune_mnist(args.num_samples, args.num_epochs, args.num_workers,
+               args.smoke_test)

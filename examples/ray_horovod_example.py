@@ -1,0 +1,71 @@
+"""MNIST via the hosts x slots accelerator (capability parity with reference
+examples/ray_horovod_example.py:63-196 -- same --num-hosts/--num-slots CLI).
+On TPU the ring-allreduce protocol is XLA's collectives over ICI; the
+hosts x slots topology maps to (DCN processes) x (local chips)."""
+
+import argparse
+import os
+import tempfile
+
+from ray_lightning_accelerators_tpu import (HorovodRayAccelerator, Trainer,
+                                            TuneReportCallback, tune)
+from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
+                                                         MNISTDataModule)
+
+
+def train_mnist(config, num_epochs=10, num_hosts=1, num_slots=1,
+                callbacks=None, smoke=False):
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=config["batch_size"],
+                         n_train=2048 if smoke else 55000,
+                         n_val=512 if smoke else 5000)
+    trainer = Trainer(
+        max_epochs=num_epochs, callbacks=list(callbacks or []),
+        accelerator=HorovodRayAccelerator(num_hosts=num_hosts,
+                                          num_slots=num_slots),
+        default_root_dir=os.path.join(tempfile.gettempdir(),
+                                      "rla_tpu_horovod"))
+    trainer.fit(model, datamodule=dm)
+    return trainer
+
+
+def tune_mnist(num_samples=10, num_epochs=10, num_hosts=1, num_slots=1,
+               smoke=False):
+    config = {
+        "layer_1": tune.choice([32, 64, 128]),
+        "layer_2": tune.choice([64, 128, 256]),
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "batch_size": tune.choice([32, 64, 128]),
+    }
+    metrics = {"loss": "ptl/val_loss", "acc": "ptl/val_accuracy"}
+    callbacks = [TuneReportCallback(metrics, on="validation_end")]
+    analysis = tune.run(
+        lambda cfg: train_mnist(cfg, num_epochs, num_hosts, num_slots,
+                                callbacks, smoke),
+        config=config, num_samples=num_samples, metric="loss", mode="min",
+        name="tune_mnist_horovod")
+    print("Best hyperparameters found were:", analysis.best_config)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-hosts", type=int, default=1)
+    parser.add_argument("--num-slots", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--num-samples", type=int, default=10)
+    parser.add_argument("--use-gpu", action="store_true",
+                        help="Accepted for reference parity; ignored on TPU.")
+    parser.add_argument("--tune", action="store_true")
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    if args.smoke_test:
+        args.num_epochs, args.num_samples = 1, 1
+    if args.tune:
+        tune_mnist(args.num_samples, args.num_epochs, args.num_hosts,
+                   args.num_slots, args.smoke_test)
+    else:
+        config = {"layer_1": 128, "layer_2": 256, "lr": 1e-3,
+                  "batch_size": 128}
+        trainer = train_mnist(config, args.num_epochs, args.num_hosts,
+                              args.num_slots, smoke=args.smoke_test)
+        print("final metrics:", trainer.callback_metrics)

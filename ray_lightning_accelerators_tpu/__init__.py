@@ -18,6 +18,8 @@ from .data.loader import (ArrayDataset, DataLoader, Dataset, RandomDataset,
                           ShardedSampler)
 from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.session import get_actor_rank, init_session, put_queue
+from . import tune
+from .tune import TuneReportCallback, TuneReportCheckpointCallback
 
 __version__ = "0.1.0"
 
@@ -30,4 +32,5 @@ __all__ = [
     "ShardedSampler",
     "MeshConfig", "build_mesh",
     "get_actor_rank", "init_session", "put_queue",
+    "tune", "TuneReportCallback", "TuneReportCheckpointCallback",
 ]

@@ -40,7 +40,28 @@ class Accelerator:
     # Topology                                                          #
     # ---------------------------------------------------------------- #
     def select_devices(self) -> list:
-        return list(jax.devices())
+        devices = list(jax.devices())
+        cfg = self.mesh_config
+        sizes = (cfg.data, cfg.fsdp, cfg.pipeline, cfg.expert, cfg.sequence,
+                 cfg.tensor)
+        if -1 not in sizes:  # fully specified mesh
+            import math
+            need = math.prod(sizes)
+            if need > len(devices):
+                raise ValueError(f"mesh needs {need} devices but only "
+                                 f"{len(devices)} are visible")
+            if need < len(devices):
+                if jax.process_count() > 1:
+                    # truncating jax.devices() across processes would build a
+                    # mesh that excludes some hosts' local devices entirely
+                    # (their device_put/collectives would then hang or fail)
+                    raise ValueError(
+                        f"mesh covers {need} of {len(devices)} devices; in "
+                        f"multi-process mode the mesh must span every "
+                        f"process -- size the mesh to the full device count "
+                        f"or pass an explicit device list")
+                devices = devices[:need]
+        return devices
 
     def build_mesh(self) -> Mesh:
         if self._mesh is None:
@@ -67,21 +88,49 @@ class Accelerator:
     def batch_sharding(self, mesh: Mesh) -> NamedSharding:
         return mesh_lib.batch_sharding(mesh)
 
-    def state_shardings(self, mesh: Mesh, state: Any) -> Any:
-        """Sharding pytree for the TrainState.  Default: params/opt replicated
-        (pure DP); with use_fsdp, large leaves shard over the fsdp axis."""
-        if not self.use_fsdp:
-            repl = NamedSharding(mesh, P())
-            return jax.tree.map(lambda _: repl, state)
+    def state_shardings(self, mesh: Mesh, state: Any, module: Any = None,
+                        tx: Any = None) -> Any:
+        """Sharding pytree for the TrainState.
+
+        Priority: a module exposing ``param_logical_axes()`` gets rule-based
+        shardings (tp/fsdp/sp-aware); otherwise ``use_fsdp`` shards large
+        leaves over the fsdp axis; otherwise everything replicates (pure DP).
+        Optimizer moments inherit each param's layout via
+        ``optax.tree_map_params``.
+        """
+        import optax as _optax
+
         repl = NamedSharding(mesh, P())
-        return state.replace(
-            step=repl,
-            params=sharding_lib.infer_fsdp_shardings(state.params, mesh),
-            # optimizer moments mirror param shapes, so the same size/divisibility
-            # heuristic lands them on the same layout
-            opt_state=sharding_lib.infer_fsdp_shardings(state.opt_state, mesh),
-            rng=repl,
-        )
+        if module is not None and hasattr(module, "param_logical_axes"):
+            param_sh = sharding_lib.tree_logical_to_shardings(
+                mesh, module.param_logical_axes())
+        elif self.use_fsdp:
+            param_sh = sharding_lib.infer_fsdp_shardings(state.params, mesh)
+        else:
+            param_sh = jax.tree.map(lambda _: repl, state.params)
+
+        params_sharded = any(
+            not s.is_fully_replicated for s in jax.tree.leaves(param_sh))
+        if tx is not None:
+            try:
+                opt_sh = _optax.tree_map_params(
+                    tx, lambda _s, p_sh: p_sh, state.opt_state, param_sh,
+                    transform_non_params=lambda _s: repl)
+            except Exception as e:  # exotic optimizer state shapes
+                opt_sh = jax.tree.map(lambda _: repl, state.opt_state)
+                if params_sharded:
+                    log.warning(
+                        "could not map param shardings onto the optimizer "
+                        "state (%s: %s); optimizer moments will be fully "
+                        "REPLICATED -- expect ~3x param memory per device, "
+                        "defeating FSDP savings", type(e).__name__, e)
+        else:
+            opt_sh = jax.tree.map(lambda _: repl, state.opt_state)
+            if params_sharded:
+                log.warning("state_shardings called without tx; optimizer "
+                            "moments will be fully replicated")
+        return state.replace(step=repl, params=param_sh, opt_state=opt_sh,
+                             rng=repl)
 
     # ---------------------------------------------------------------- #
     # Lifecycle + parity surface                                        #

@@ -56,18 +56,21 @@ class RayTPUAccelerator(Accelerator):
         self.num_workers = num_workers
 
     def select_devices(self):
-        devices = jax.devices()
-        total_model = (self.mesh_config.tensor * self.mesh_config.sequence *
-                       self.mesh_config.pipeline * self.mesh_config.expert)
-        if self.num_workers is not None:
-            need = self.num_workers * total_model
-            if need > len(devices):
+        # base handles the fully-specified case (truncation + multi-process
+        # guard); decorate its error with the num_workers framing
+        try:
+            return super().select_devices()
+        except ValueError as e:
+            if self.num_workers is not None and "are visible" in str(e):
+                total_model = (self.mesh_config.tensor *
+                               self.mesh_config.sequence *
+                               self.mesh_config.pipeline *
+                               self.mesh_config.expert)
                 raise ValueError(
-                    f"requested {need} devices "
+                    f"requested {self.num_workers * total_model} devices "
                     f"(num_workers={self.num_workers} x model={total_model}) "
-                    f"but only {len(devices)} are visible")
-            devices = devices[:need]
-        return devices
+                    f"but only {len(jax.devices())} are visible") from e
+            raise
 
 
 class RayAccelerator(RayTPUAccelerator):

@@ -37,6 +37,7 @@ class TpuModule:
         self.params: Any = None          # populated by Trainer after fit()
         self.trainer = None              # backref set by Trainer
         self.compute_dtype = jnp.float32  # set from Trainer(precision=...)
+        self.mesh = None                 # set by Trainer before tracing
 
     # ------------------------------------------------------------------ #
     # Methods the user overrides.                                        #

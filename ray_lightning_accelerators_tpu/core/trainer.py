@@ -174,8 +174,10 @@ class Trainer:
 
     def _compile(self, module: TpuModule, state: TrainState, example_batch):
         mesh = self._mesh
+        module.mesh = mesh  # models use this for sharding constraints
         batch_sh = self.accelerator.batch_sharding(mesh)
-        state_sh = self.accelerator.state_shardings(mesh, state)
+        state_sh = self.accelerator.state_shardings(mesh, state,
+                                                    module=module, tx=self._tx)
         tx = self._tx
 
         def train_step(st: TrainState, batch):

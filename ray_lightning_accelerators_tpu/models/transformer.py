@@ -1,0 +1,243 @@
+"""Flagship model: GPT-style decoder LM, sharded over every mesh axis.
+
+No reference analog (the reference delegates models to the user; its largest
+example is an MNIST MLP, examples/ray_ddp_example.py:18-59).  This model
+exists to exercise and benchmark the framework's TPU path end-to-end:
+
+- parameters carry **logical axis names** translated to mesh shardings by
+  `parallel.sharding` (embed->fsdp for ZeRO-3, mlp/heads/vocab->tensor for
+  megatron-style TP, batch->(data,fsdp), seq->sequence);
+- layers are **stacked and scanned** (`lax.scan` over the layer dim): one
+  trace/compile regardless of depth, optional `jax.checkpoint` remat, and
+  the natural substrate for pipeline parallelism;
+- attention dispatches to the Pallas flash kernel single-shard or ring
+  attention when the mesh has a `sequence` axis (context parallelism);
+- compute in bf16 (MXU-native), accumulation and softmax statistics in f32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.module import TpuModule
+from ..parallel import mesh as mesh_lib
+from ..parallel import sharding as sharding_lib
+from ..parallel.ring_attention import ring_attention_sharded
+from ..ops.attention import flash_attention
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_layers: int = 8
+    max_seq_len: int = 2048
+    dropout: float = 0.0          # (kept 0 in bench; rng plumbed for parity)
+    causal: bool = True
+    remat: bool = False           # jax.checkpoint each layer
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings.  x: [b, h, s, d], positions: [s]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [s,d/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class GPT(TpuModule):
+    """Decoder-only LM.  Batch format: dict(input_ids=[B,S] int32) or a bare
+    [B,S] array; loss = next-token cross entropy."""
+
+    def __init__(self, config: Optional[TransformerConfig] = None,
+                 lr: float = 3e-4, **cfg_overrides):
+        super().__init__()
+        if config is None:
+            config = TransformerConfig(**cfg_overrides)
+        self.cfg = config
+        self.lr = lr
+        self.save_hyperparameters(config=dataclasses.asdict(config), lr=lr)
+
+    # ------------------------------------------------------------------ #
+    # Parameters                                                         #
+    # ------------------------------------------------------------------ #
+    def init_params(self, rng) -> Dict[str, Any]:
+        cfg = self.cfg
+        d, h, hd, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+        k_embed, k_layers, k_out = jax.random.split(rng, 3)
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (fan_in ** -0.5))
+
+        def layer(key):
+            ks = jax.random.split(key, 6)
+            return {
+                "attn": {
+                    "wq": dense(ks[0], (d, h, hd), d),
+                    "wk": dense(ks[1], (d, h, hd), d),
+                    "wv": dense(ks[2], (d, h, hd), d),
+                    "wo": dense(ks[3], (h, hd, d), d),
+                },
+                "mlp": {
+                    "wi": dense(ks[4], (d, f), d),
+                    "wo": dense(ks[5], (f, d), f),
+                },
+                "ln1": jnp.ones((d,), jnp.float32),
+                "ln2": jnp.ones((d,), jnp.float32),
+            }
+
+        layer_keys = jax.random.split(k_layers, cfg.n_layers)
+        layers = jax.vmap(layer)(layer_keys)  # stacked: leading dim n_layers
+        params = {
+            "embed": dense(k_embed, (cfg.vocab_size, d), d) * d ** 0.5 * 0.02,
+            "layers": layers,
+            "ln_f": jnp.ones((d,), jnp.float32),
+        }
+        if not cfg.tie_embeddings:
+            params["unembed"] = dense(k_out, (d, cfg.vocab_size), d)
+        return params
+
+    def param_logical_axes(self) -> Dict[str, Any]:
+        """Logical axis names per leaf; consumed by the accelerator to build
+        mesh shardings (parallel/sharding.py rules)."""
+        axes = {
+            "embed": ("vocab", "embed"),
+            "layers": {
+                "attn": {
+                    "wq": ("layers", "embed", "heads", "kv"),
+                    "wk": ("layers", "embed", "heads", "kv"),
+                    "wv": ("layers", "embed", "heads", "kv"),
+                    "wo": ("layers", "heads", "kv", "embed"),
+                },
+                "mlp": {
+                    "wi": ("layers", "embed", "mlp"),
+                    "wo": ("layers", "mlp", "embed"),
+                },
+                "ln1": ("layers", None),
+                "ln2": ("layers", None),
+            },
+            "ln_f": (None,),
+        }
+        if not self.cfg.tie_embeddings:
+            axes["unembed"] = ("embed", "vocab")
+        return axes
+
+    # ------------------------------------------------------------------ #
+    # Forward                                                            #
+    # ------------------------------------------------------------------ #
+    def _constrain(self, x, *spec):
+        if self.mesh is not None:
+            return sharding_lib.shard_constraint(
+                x, self.mesh, jax.sharding.PartitionSpec(*spec))
+        return x
+
+    def _rms_norm(self, x, scale):
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+    def _attention(self, q, k, v):
+        if self.mesh is not None and mesh_lib.mesh_axis_size(
+                self.mesh, mesh_lib.SEQUENCE_AXIS) > 1:
+            return ring_attention_sharded(q, k, v, self.mesh,
+                                          causal=self.cfg.causal)
+        return flash_attention(q, k, v, self.cfg.causal)
+
+    def _block(self, h, layer_params, positions):
+        cfg = self.cfg
+        dt = self.compute_dtype
+        a = layer_params["attn"]
+        x = self._rms_norm(h, layer_params["ln1"])
+        q = jnp.einsum("bsd,dhk->bhsk", x, a["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bhsk", x, a["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bhsk", x, a["wv"].astype(dt))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        q = self._constrain(q, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
+                            mesh_lib.SEQUENCE_AXIS, None)
+        k = self._constrain(k, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
+                            mesh_lib.SEQUENCE_AXIS, None)
+        v = self._constrain(v, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
+                            mesh_lib.SEQUENCE_AXIS, None)
+        attn = self._attention(q, k, v)
+        h = h + jnp.einsum("bhsk,hkd->bsd", attn, a["wo"].astype(dt))
+
+        x = self._rms_norm(h, layer_params["ln2"])
+        m = layer_params["mlp"]
+        up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"].astype(dt)))
+        up = self._constrain(up, mesh_lib.BATCH_AXES, mesh_lib.SEQUENCE_AXIS,
+                             mesh_lib.TENSOR_AXIS)
+        h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
+        return self._constrain(h, mesh_lib.BATCH_AXES,
+                               mesh_lib.SEQUENCE_AXIS, None)
+
+    def forward(self, params, batch):
+        tokens = batch["input_ids"] if isinstance(batch, dict) else batch
+        if isinstance(tokens, (tuple, list)):
+            tokens = tokens[0]
+        dt = self.compute_dtype
+        b, s = tokens.shape
+        positions = jnp.arange(s)
+        h = params["embed"].astype(dt)[tokens]
+        h = self._constrain(h, mesh_lib.BATCH_AXES,
+                            mesh_lib.SEQUENCE_AXIS, None)
+
+        def block(carry, layer_params):
+            return self._block(carry, layer_params, positions), None
+
+        if self.cfg.remat:
+            block = jax.checkpoint(block)
+        h, _ = jax.lax.scan(block, h, params["layers"])
+        h = self._rms_norm(h, params["ln_f"])
+        unembed = (params["embed"].T if self.cfg.tie_embeddings
+                   else params["unembed"])
+        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dt))
+        return logits.astype(jnp.float32)
+
+    # ------------------------------------------------------------------ #
+    # Steps                                                              #
+    # ------------------------------------------------------------------ #
+    def _lm_loss(self, params, batch):
+        tokens = batch["input_ids"] if isinstance(batch, dict) else batch
+        if isinstance(tokens, (tuple, list)):
+            tokens = tokens[0]
+        logits = self.forward(params, tokens)
+        targets = tokens[:, 1:]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], targets).mean()
+        acc = jnp.mean(jnp.argmax(logits[:, :-1], -1) == targets)
+        return loss, acc
+
+    def training_step(self, params, batch, rng):
+        loss, acc = self._lm_loss(params, batch)
+        return loss, {"loss": loss, "accuracy": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._lm_loss(params, batch)
+        return {"val_loss": loss, "val_accuracy": acc,
+                "val_perplexity": jnp.exp(loss)}
+
+    def predict_step(self, params, batch):
+        return self.forward(params, batch)
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=0.01)

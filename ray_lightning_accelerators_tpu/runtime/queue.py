@@ -1,0 +1,75 @@
+"""Callable-trampoline queue + driver result pump.
+
+Direct capability analog of the reference's queue/poll machinery
+(reference: ray_lightning/util.py -- `_QueueActor` :22-68, `Queue` :71-85,
+`_handle_queue` :88-93, `process_results` :96-109): workers ship zero-arg
+callables to the process that owns the Tune session; the driver executes
+them while the training work runs.
+
+TPU-native simplifications: without Ray the queue is a thread-safe
+``queue.Queue`` (in-process trials, the default -- one process owns the TPU)
+or a ``multiprocessing`` queue (subprocess trials); the "future" being polled
+is a ``concurrent.futures.Future`` instead of a Ray ObjectRef.  The
+drain-then-check loop and the final drain after completion (the race-closure
+the reference handles at util.py:106-108) are preserved.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class TrampolineQueue:
+    """put((rank, callable)) from workers; driver get()s and invokes."""
+
+    def __init__(self, backend: Optional[Any] = None):
+        self._q = backend if backend is not None else queue_mod.Queue()
+
+    def put(self, item: Tuple[int, Callable[[], Any]]) -> None:
+        self._q.put(item)
+
+    def get_nowait(self):
+        try:
+            return self._q.get_nowait()
+        except queue_mod.Empty:
+            return None
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def shutdown(self) -> None:
+        pass
+
+
+def drain_queue(q: Optional[TrampolineQueue]) -> int:
+    """Execute every queued callable in the driver process
+    (reference: util.py:88-93)."""
+    if q is None:
+        return 0
+    n = 0
+    while True:
+        item = q.get_nowait()
+        if item is None:
+            break
+        _rank, fn = item
+        fn()
+        n += 1
+    return n
+
+
+def process_results(futures: List[Future], q: Optional[TrampolineQueue],
+                    poll_s: float = 0.01) -> List[Any]:
+    """Poll training futures while draining the trampoline queue; final drain
+    after completion closes the enqueue/finish race
+    (reference: util.py:96-109)."""
+    pending = list(futures)
+    while pending:
+        drain_queue(q)
+        pending = [f for f in pending if not f.done()]
+        if pending:
+            time.sleep(poll_s)
+    drain_queue(q)
+    return [f.result() for f in futures]  # re-raises worker exceptions

@@ -1,0 +1,18 @@
+"""Tune-equivalent subsystem: search spaces, trial runner, bridge callbacks.
+
+Usable both as ``from ray_lightning_accelerators_tpu import tune; tune.run(...)``
+(the reference's `from ray import tune` shape) and via direct imports of the
+callbacks (the reference's `from ray_lightning.tune import TuneReportCallback`).
+"""
+
+from .callbacks import TuneReportCallback, TuneReportCheckpointCallback
+from .run import (ExperimentAnalysis, Trial, checkpoint_payload,
+                  is_session_enabled, report, run)
+from .search import (choice, grid_search, loguniform, randint, uniform)
+
+__all__ = [
+    "run", "report", "checkpoint_payload", "is_session_enabled",
+    "ExperimentAnalysis", "Trial",
+    "choice", "uniform", "loguniform", "randint", "grid_search",
+    "TuneReportCallback", "TuneReportCheckpointCallback",
+]

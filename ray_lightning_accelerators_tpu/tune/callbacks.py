@@ -1,0 +1,141 @@
+"""Tune bridge callbacks: report + checkpoint from training into trials.
+
+Name-for-name port of the reference's public callback surface
+(reference: ray_lightning/tune.py -- TuneReportCallback :26-101,
+_TuneCheckpointCallback :103-142, TuneReportCheckpointCallback :144-199)
+rebuilt on this framework's Trainer.  The signature mechanism is preserved:
+callbacks run where training runs and ship **zero-arg thunks** through the
+session queue; the driver executes them where the trial session lives
+(reference: tune.py:101 -> session.py:61-63 -> util.py:88-93).
+
+TPU-native detail: `trainer.callback_metrics` is already host floats --
+the trainer materialized them at the validation boundary -- so harvesting
+here never forces an XLA sync (the `.item()` hazard SURVEY.md §7.2 flags
+at reference tune.py:85,94).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from ..core.callbacks import Callback
+from ..runtime import session as session_lib
+from ..utils.logging import log
+from . import run as run_lib
+
+_HOOK_MAP = {
+    "validation_end": "on_validation_end",
+    "train_epoch_end": "on_train_epoch_end",
+    "fit_end": "on_fit_end",
+    "train_end": "on_fit_end",
+    "batch_end": "on_train_batch_end",
+    "train_batch_end": "on_train_batch_end",
+}
+
+
+class TuneCallback(Callback):
+    """Dispatch base: fires `_handle` on the configured hook(s)
+    (reference: ray.tune.integration TuneCallback as used at tune.py:26)."""
+
+    def __init__(self, on: Union[str, List[str]] = "validation_end"):
+        if isinstance(on, str):
+            on = [on]
+        unknown = [h for h in on if h not in _HOOK_MAP]
+        if unknown:
+            raise ValueError(
+                f"unsupported hook(s) {unknown}; choose from "
+                f"{sorted(_HOOK_MAP)}")
+        self._on = [_HOOK_MAP[h] for h in on]
+
+    def _handle(self, trainer, module) -> None:
+        raise NotImplementedError
+
+    def _dispatch(self, hook: str, trainer, module) -> None:
+        if hook in self._on:
+            self._handle(trainer, module)
+
+    def on_validation_end(self, trainer, module) -> None:
+        self._dispatch("on_validation_end", trainer, module)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        self._dispatch("on_train_epoch_end", trainer, module)
+
+    def on_fit_end(self, trainer, module) -> None:
+        self._dispatch("on_fit_end", trainer, module)
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
+        self._dispatch("on_train_batch_end", trainer, module)
+
+
+class TuneReportCallback(TuneCallback):
+    """Report `metrics` from trainer.callback_metrics to the current trial
+    (reference: tune.py:26-101; metrics str|list|dict semantics at :77-95)."""
+
+    def __init__(self,
+                 metrics: Union[None, str, List[str], Dict[str, str]] = None,
+                 on: Union[str, List[str]] = "validation_end"):
+        super().__init__(on)
+        if isinstance(metrics, str):
+            metrics = [metrics]
+        self._metrics = metrics
+
+    def _get_report_dict(self, trainer, module) -> Optional[Dict[str, float]]:
+        if trainer.sanity_checking:  # reference: tune.py:79-81
+            return None
+        if not self._metrics:
+            return dict(trainer.callback_metrics)
+        report = {}
+        if isinstance(self._metrics, dict):
+            items = self._metrics.items()
+        else:
+            items = [(m, m) for m in self._metrics]
+        for tune_key, pl_key in items:
+            if pl_key in trainer.callback_metrics:
+                report[tune_key] = float(trainer.callback_metrics[pl_key])
+            else:
+                log.warning("metric %r not found in callback_metrics %s",
+                            pl_key, sorted(trainer.callback_metrics))
+        return report
+
+    def _handle(self, trainer, module) -> None:
+        report = self._get_report_dict(trainer, module)
+        if report:
+            # thunk through the session queue (reference: tune.py:101)
+            session_lib.put_queue(lambda: run_lib.report(**report))
+
+
+class _TuneCheckpointCallback(TuneCallback):
+    """Ship the FULL trainer checkpoint to the trial's checkpoint dir
+    (reference: tune.py:103-142 -- dump on worker :138, write driver-side
+    under tune.checkpoint_dir with atomic_save :128-133)."""
+
+    def __init__(self, filename: str = "checkpoint",
+                 on: Union[str, List[str]] = "validation_end"):
+        super().__init__(on)
+        self._filename = filename
+
+    def _handle(self, trainer, module) -> None:
+        if trainer.sanity_checking:
+            return
+        payload = trainer.dump_checkpoint()  # host-side, mesh-materialized
+        step = trainer.global_step
+        filename = self._filename
+        session_lib.put_queue(
+            lambda: run_lib.checkpoint_payload(payload, step, filename))
+
+
+class TuneReportCheckpointCallback(TuneCallback):
+    """Checkpoint THEN report, so the trial registers the checkpoint with the
+    metric (reference: tune.py:144-199, ordering note at :197-199)."""
+
+    def __init__(self,
+                 metrics: Union[None, str, List[str], Dict[str, str]] = None,
+                 filename: str = "checkpoint",
+                 on: Union[str, List[str]] = "validation_end"):
+        super().__init__(on)
+        self._checkpoint = _TuneCheckpointCallback(filename, on)
+        self._report = TuneReportCallback(metrics, on)
+
+    def _handle(self, trainer, module) -> None:
+        self._checkpoint._handle(trainer, module)
+        self._report._handle(trainer, module)

@@ -1,0 +1,218 @@
+"""tune.run: multi-trial hyperparameter search with the reference's shape.
+
+Capability analog of Ray Tune as the reference consumes it
+(reference: examples/ray_ddp_example.py:94-113 -- tune.run over a train
+function, metric/mode, num_samples, analysis.best_config; tests at
+ray_lightning/tests/test_tune.py:33-75 -- results_df iteration counts and
+best_checkpoint existence).
+
+TPU-native redesign: trials run **sequentially in-process by default** --
+on TPU, one process owns the chips, so concurrent trials would fight over
+them; multi-trial parallelism across hosts is the actor runtime's job.  Each
+trial's trainable runs in a worker thread while the driver thread drains the
+callable-trampoline queue (the reference's process_results loop,
+reference: util.py:96-109), preserving the exact report/checkpoint
+architecture so the same callbacks work over the subprocess/actor executors.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..runtime import session as session_lib
+from ..runtime.queue import TrampolineQueue, process_results
+from ..utils import checkpoint as ckpt_lib
+from ..utils.logging import log
+from .search import generate_trial_configs
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any], local_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.logdir = os.path.join(local_dir, trial_id)
+        os.makedirs(self.logdir, exist_ok=True)
+        self.results: List[Dict[str, Any]] = []
+        self.checkpoints: List[Tuple[int, str]] = []  # (step, path)
+        self.status = "PENDING"
+        self.error: Optional[BaseException] = None
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.results[-1] if self.results else {}
+
+    @property
+    def training_iteration(self) -> int:
+        return len(self.results)
+
+    def report(self, metrics: Dict[str, Any]) -> None:
+        row = dict(metrics)
+        row["training_iteration"] = self.training_iteration + 1
+        row["trial_id"] = self.trial_id
+        self.results.append(row)
+
+    def create_checkpoint(self, payload: Dict[str, Any], step: int,
+                          filename: str) -> str:
+        cdir = os.path.join(self.logdir, f"checkpoint_{step:06d}")
+        path = os.path.join(cdir, filename)
+        ckpt_lib.atomic_save(payload, path)
+        self.checkpoints.append((step, path))
+        return path
+
+    def best_checkpoint_path(self) -> Optional[str]:
+        return self.checkpoints[-1][1] if self.checkpoints else None
+
+
+class _TrialSession:
+    """Driver-side marker that a trial is active in this process (the analog
+    of a Ray Tune session; probed via is_session_enabled,
+    reference: ray_lightning/tune.py:10-22)."""
+
+    def __init__(self, trial: Trial):
+        self.trial = trial
+        self._lock = threading.Lock()
+
+    def report(self, **metrics) -> None:
+        with self._lock:
+            self.trial.report(metrics)
+
+
+_trial_session: Optional[_TrialSession] = None
+
+
+def is_session_enabled() -> bool:
+    return _trial_session is not None
+
+
+def get_trial_session() -> _TrialSession:
+    if _trial_session is None:
+        raise RuntimeError("tune.report()/checkpointing used outside a "
+                           "tune.run() trial")
+    return _trial_session
+
+
+def report(**metrics) -> None:
+    """Report metrics for the current trial.
+
+    Callable from the driver thread (via trampoline thunks, the reference
+    path) or directly from the trial thread (convenience the reference
+    lacked -- its workers had no session and HAD to trampoline,
+    reference: tune.py:97-101).
+    """
+    get_trial_session().report(**metrics)
+
+
+def checkpoint_payload(payload: Dict[str, Any], step: int,
+                       filename: str = "checkpoint") -> str:
+    return get_trial_session().trial.create_checkpoint(payload, step, filename)
+
+
+class ExperimentAnalysis:
+    """Results container (reference surface: analysis.best_config at
+    README.md:107, results_df / best_checkpoint at tests/test_tune.py:42-75)."""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str = "min"):
+        self.trials = trials
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, trial: Trial) -> Optional[float]:
+        if self.metric is None or self.metric not in trial.last_result:
+            return None
+        return float(trial.last_result[self.metric])
+
+    @property
+    def best_trial(self) -> Trial:
+        scored = [(self._score(t), t) for t in self.trials
+                  if self._score(t) is not None]
+        if not scored:
+            if self.metric is not None:
+                raise ValueError(
+                    f"no trial reported metric {self.metric!r}")
+            return self.trials[0]
+        pick = min if self.mode == "min" else max
+        return pick(scored, key=lambda st: st[0])[1]
+
+    @property
+    def best_config(self) -> Dict[str, Any]:
+        return self.best_trial.config
+
+    @property
+    def best_result(self) -> Dict[str, Any]:
+        return self.best_trial.last_result
+
+    @property
+    def best_checkpoint(self) -> Optional[str]:
+        return self.best_trial.best_checkpoint_path()
+
+    @property
+    def results_df(self):
+        """pandas DataFrame of final results, one row per trial, with
+        config.* columns (shape matched to the reference's assertions,
+        tests/test_tune.py:42-44)."""
+        import pandas as pd
+        rows = []
+        for t in self.trials:
+            row = dict(t.last_result)
+            for k, v in t.config.items():
+                row[f"config.{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+def run(trainable: Callable[[Dict[str, Any]], Any],
+        config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1,
+        metric: Optional[str] = None,
+        mode: str = "min",
+        name: Optional[str] = None,
+        local_dir: Optional[str] = None,
+        resources_per_trial: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        raise_on_failed_trial: bool = True,
+        verbose: int = 0,
+        **_compat_kwargs) -> ExperimentAnalysis:
+    """Run `trainable(config)` for every sampled/grid config.
+
+    `resources_per_trial` is accepted for signature parity (the reference's
+    extra_cpu bookkeeping, examples/ray_ddp_example.py:107-112) -- placement
+    is meaningful only under the multi-host actor runtime.
+    """
+    name = name or f"tune_{int(time.time())}"
+    local_dir = local_dir or os.path.join(os.getcwd(), "rla_tpu_results")
+    exp_dir = os.path.join(local_dir, name)
+    os.makedirs(exp_dir, exist_ok=True)
+
+    configs = generate_trial_configs(config, num_samples, seed)
+    trials = []
+    global _trial_session
+    for i, cfg in enumerate(configs):
+        trial = Trial(f"trial_{i:05d}", cfg, exp_dir)
+        trials.append(trial)
+        q = TrampolineQueue()
+        _trial_session = _TrialSession(trial)
+        session_lib.init_session(rank=0, queue=q)
+        trial.status = "RUNNING"
+        try:
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                fut = pool.submit(trainable, cfg)
+                process_results([fut], q)
+            trial.status = "TERMINATED"
+        except BaseException as e:  # noqa: BLE001 - fail-fast like ray.get
+            trial.status = "ERROR"
+            trial.error = e
+            log.warning("trial %s failed: %s", trial.trial_id, e)
+            if raise_on_failed_trial:
+                raise
+        finally:
+            session_lib.shutdown_session()
+            _trial_session = None
+        if verbose:
+            log.warning("trial %s finished: %s", trial.trial_id,
+                        trial.last_result)
+    return ExperimentAnalysis(trials, metric, mode)

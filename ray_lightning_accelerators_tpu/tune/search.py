@@ -1,0 +1,106 @@
+"""Search-space primitives: the subset of the Tune API the reference's
+examples/tests exercise (choice/loguniform at examples/ray_ddp_example.py:84-89,
+uniform/grid in the README; reference: README.md:88-93).
+
+Each primitive is a Domain object; `expand_grid` + `Domain.sample` turn a
+config spec into concrete trial configs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+class Choice(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[int(rng.integers(len(self.categories)))]
+
+
+class Uniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return float(rng.uniform(self.lower, self.upper))
+
+
+class LogUniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        assert lower > 0 and upper > lower
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return float(np.exp(rng.uniform(np.log(self.lower),
+                                        np.log(self.upper))))
+
+
+class RandInt(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return int(rng.integers(self.lower, self.upper))
+
+
+class GridSearch:
+    """Marker: every value is enumerated (cartesian with other grids)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def choice(categories: Sequence[Any]) -> Choice:
+    return Choice(categories)
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> LogUniform:
+    return LogUniform(lower, upper)
+
+
+def randint(lower: int, upper: int) -> RandInt:
+    return RandInt(lower, upper)
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def generate_trial_configs(config: Dict[str, Any], num_samples: int,
+                           seed: int = 0) -> List[Dict[str, Any]]:
+    """Expand grids cartesian-style, sample Domains `num_samples` times.
+
+    Matches Tune semantics: num_samples repeats the whole (grid x sample)
+    space; plain values pass through.
+    """
+    config = dict(config or {})
+    grid_keys = [k for k, v in config.items() if isinstance(v, GridSearch)]
+    grids = [config[k].values for k in grid_keys]
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num_samples):
+        for combo in itertools.product(*grids) if grids else [()]:
+            trial_cfg = {}
+            for k, v in config.items():
+                if isinstance(v, GridSearch):
+                    trial_cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    trial_cfg[k] = v.sample(rng)
+                else:
+                    trial_cfg[k] = v
+            out.append(trial_cfg)
+    return out

@@ -1,0 +1,90 @@
+"""Flagship GPT: trains under every parallelism mix on the 8-device mesh and
+its params actually land sharded where the logical rules say."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (Accelerator, DataLoader,
+                                            MeshConfig, Trainer)
+from ray_lightning_accelerators_tpu.data.loader import Dataset
+from ray_lightning_accelerators_tpu.models.transformer import (GPT,
+                                                               TransformerConfig)
+
+VOCAB = 128
+
+
+class TokenDataset(Dataset):
+    """Deterministic repeating-pattern token sequences (learnable LM task)."""
+
+    def __init__(self, n: int = 128, seq: int = 64, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        starts = rng.integers(0, VOCAB, size=n)
+        ramp = np.arange(seq)[None, :]
+        self.data = ((starts[:, None] + ramp) % VOCAB).astype(np.int32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=VOCAB, d_model=64, n_heads=4, d_ff=128,
+                n_layers=2, max_seq_len=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _fit(tmpdir, mesh_config, batch_size=16, max_epochs=2, **cfg_kw):
+    model = GPT(tiny_cfg(**cfg_kw), lr=1e-2)
+    trainer = Trainer(max_epochs=max_epochs,
+                      accelerator=Accelerator(mesh_config),
+                      default_root_dir=str(tmpdir), precision="f32",
+                      enable_checkpointing=False, seed=0)
+    loader = DataLoader(TokenDataset(), batch_size=batch_size, shuffle=True)
+    val = DataLoader(TokenDataset(seed=1), batch_size=batch_size)
+    trainer.fit(model, loader, val)
+    return trainer, model
+
+
+@pytest.mark.parametrize("mesh_config", [
+    MeshConfig(data=8),
+    MeshConfig(data=2, fsdp=2, tensor=2),
+    MeshConfig(data=1, fsdp=2, sequence=2, tensor=2),
+], ids=["dp8", "dp2-fsdp2-tp2", "fsdp2-sp2-tp2"])
+def test_gpt_trains_under_parallelism(tmpdir, mesh_config):
+    trainer, model = _fit(tmpdir, mesh_config)
+    assert trainer.callback_metrics["val_loss"] < jnp.log(VOCAB)  # < chance
+    assert model.params is not None
+
+
+def test_gpt_params_sharded_by_rules(tmpdir):
+    trainer, model = _fit(tmpdir, MeshConfig(data=1, fsdp=2, tensor=4))
+    wi = trainer._state.params["layers"]["mlp"]["wi"]  # (layers, d, ff)
+    # mlp axis -> tensor(4), embed axis -> fsdp(2): 8 distinct shards
+    assert len(wi.sharding.device_set) == 8
+    assert not wi.sharding.is_fully_replicated
+    spec = wi.sharding.spec
+    assert spec[1] == "fsdp" and spec[2] == "tensor"
+    # optimizer moments carry the same layout
+    leaves = [l for l in jax.tree.leaves(trainer._state.opt_state)
+              if hasattr(l, "shape") and l.shape == wi.shape]
+    assert leaves and all(l.sharding == wi.sharding for l in leaves)
+
+
+def test_gpt_learns_pattern(tmpdir):
+    trainer, model = _fit(tmpdir, MeshConfig(data=4), max_epochs=8)
+    assert trainer.callback_metrics["val_accuracy"] > 0.9
+
+
+def test_gpt_remat_matches(tmpdir):
+    t1, m1 = _fit(tmpdir, MeshConfig(data=2), max_epochs=1)
+    t2, m2 = _fit(tmpdir, MeshConfig(data=2), max_epochs=1, remat=True)
+    a = jax.tree.leaves(m1.params)
+    b = jax.tree.leaves(m2.params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-5, rtol=1e-5)
