@@ -178,6 +178,8 @@ class Trainer:
         batch_sh = self.accelerator.batch_sharding(mesh)
         state_sh = self.accelerator.state_shardings(mesh, state,
                                                     module=module, tx=self._tx)
+        from ..parallel.sharding import validate_shardings
+        validate_shardings(state.params, state_sh.params, mesh)
         tx = self._tx
 
         def train_step(st: TrainState, batch):

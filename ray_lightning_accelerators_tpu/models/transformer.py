@@ -42,6 +42,7 @@ class TransformerConfig:
     dropout: float = 0.0          # (kept 0 in bench; rng plumbed for parity)
     causal: bool = True
     remat: bool = False           # jax.checkpoint each layer
+    pipeline_microbatches: int = 4  # GPipe schedule when mesh has pipeline>1
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
 
@@ -201,12 +202,27 @@ class GPT(TpuModule):
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)
 
-        def block(carry, layer_params):
-            return self._block(carry, layer_params, positions), None
+        def stack(h_in, layers):
+            # positions derive from the (static) seq length; recomputed here
+            # so the pipeline stage body closes over no outer-context tracers
+            pos = jnp.arange(h_in.shape[1])
 
-        if self.cfg.remat:
-            block = jax.checkpoint(block)
-        h, _ = jax.lax.scan(block, h, params["layers"])
+            def block(carry, layer_params):
+                return self._block(carry, layer_params, pos), None
+
+            if self.cfg.remat:
+                block = jax.checkpoint(block)
+            out, _ = jax.lax.scan(block, h_in, layers)
+            return out
+
+        if self.mesh is not None and mesh_lib.mesh_axis_size(
+                self.mesh, mesh_lib.PIPELINE_AXIS) > 1:
+            from ..parallel.pipeline import pipeline_apply
+            h = pipeline_apply(lambda lp, hm: stack(hm, lp),
+                               params["layers"], h, self.mesh,
+                               self.cfg.pipeline_microbatches)
+        else:
+            h = stack(h, params["layers"])
         h = self._rms_norm(h, params["ln_f"])
         unembed = (params["embed"].T if self.cfg.tie_embeddings
                    else params["unembed"])

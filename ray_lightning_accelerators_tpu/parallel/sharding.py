@@ -30,6 +30,7 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("vocab", mesh_lib.TENSOR_AXIS),
     ("expert", mesh_lib.EXPERT_AXIS),
     ("stage", mesh_lib.PIPELINE_AXIS),
+    ("layers", mesh_lib.PIPELINE_AXIS),   # stacked layer dim = stage dim
     (None, None),
 )
 
@@ -66,12 +67,50 @@ def tree_logical_to_shardings(mesh: Mesh, logical_tree: Any,
                         is_leaf=lambda x: x is None or isinstance(x, tuple))
 
 
+def validate_shardings(params, shardings, mesh: Mesh) -> None:
+    """Raise a readable error when a param dim doesn't divide by its mesh
+    axes (the raw device_put failure is impenetrable).
+
+    Structure-checked: tree_map_with_path raises on any params/shardings
+    tree mismatch instead of silently misaligning leaves.
+    """
+
+    def check(path, leaf, sh):
+        spec = getattr(sh, "spec", None)
+        if spec is None or not hasattr(leaf, "shape"):
+            return leaf
+        for d, axes in enumerate(spec):
+            if axes is None:
+                continue
+            axes = axes if isinstance(axes, tuple) else (axes,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape.get(a, 1)
+            if leaf.shape[d] % size != 0:
+                name = jax.tree_util.keystr(path)
+                raise ValueError(
+                    f"parameter {name} dim {d} (size {leaf.shape[d]}) is not "
+                    f"divisible by mesh axes {axes} (size {size}); adjust the "
+                    f"model dims or the mesh (e.g. n_layers % pipeline == 0)")
+        return leaf
+
+    jax.tree_util.tree_map_with_path(check, params, shardings)
+
+
 def shard_constraint(x, mesh: Mesh, spec: P):
-    """with_sharding_constraint that is a no-op outside jit/mesh contexts."""
-    try:
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
-    except (ValueError, RuntimeError):
-        return x
+    """with_sharding_constraint that adapts to the tracing context.
+
+    Under plain jit a concrete NamedSharding is valid; inside a
+    (partial-manual) shard_map body the ambient abstract mesh carries Manual
+    axis types and only a bare PartitionSpec resolves correctly -- a
+    NamedSharding over the concrete mesh is accepted at trace time there but
+    fails at lowering.  Context is detected explicitly so genuinely broken
+    specs still raise instead of silently no-op'ing.
+    """
+    ambient = jax.sharding.get_abstract_mesh()
+    if not ambient.empty and ambient._any_axis_manual:
+        return jax.lax.with_sharding_constraint(x, spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def replicate_tree(tree, mesh: Mesh):

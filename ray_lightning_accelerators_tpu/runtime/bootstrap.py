@@ -1,0 +1,85 @@
+"""Multi-host bootstrap: coordinator discovery + jax.distributed init.
+
+Capability analog of the reference's process-group rendezvous
+(reference: ray_lightning/ray_ddp.py:162-163 -- rank-0 actor computes a
+``tcp://ip:port`` init string; :222-237 -- every worker joins the NCCL/Gloo
+group).  TPU-native redesign: there is no per-gradient process group to
+manage -- workers call ``jax.distributed.initialize(coordinator, N, i)``
+once, PjRt forms the global device view, and XLA emits collectives from
+shardings.  The ``launch_distributed`` helper reproduces the full driver
+flow: pick a coordinator address, fan a trainable out over actor workers
+with the right env, pump the trampoline queue, and return every rank's
+result (rank-0 first -- normalizing the result-tuple inconsistency SURVEY.md
+§3.2 flags between the reference's two accelerators).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+from .actors import ActorPool
+from .queue import TrampolineQueue, process_results
+
+
+def pick_coordinator_address(port: Optional[int] = None) -> str:
+    """ip:port rendezvous string (reference setup_address analog,
+    ray_ddp.py:10,162-163)."""
+    ip = socket.gethostbyname(socket.gethostname())
+    if port is None:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+    return f"{ip}:{port}"
+
+
+def initialize_worker(coordinator_address: str, num_processes: int,
+                      process_id: int,
+                      platform: Optional[str] = None,
+                      cpu_devices_per_process: Optional[int] = None) -> None:
+    """Run INSIDE each worker before any jax use."""
+    import jax
+
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            if cpu_devices_per_process:
+                jax.config.update("jax_num_cpu_devices",
+                                  cpu_devices_per_process)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
+                       platform: Optional[str] = None,
+                       cpu_devices_per_process: Optional[int] = None,
+                       env: Optional[Dict[str, str]] = None,
+                       init_hook: Optional[Callable[[], None]] = None,
+                       queue: Optional[TrampolineQueue] = None) -> List[Any]:
+    """Fan `trainable(process_id)` over num_processes fresh processes, each
+    with a jax.distributed world formed first.  Returns per-rank results,
+    rank 0 first."""
+    coord = pick_coordinator_address()
+
+    def worker_body(process_id: int) -> Any:
+        initialize_worker(coord, num_processes, process_id, platform,
+                          cpu_devices_per_process)
+        if init_hook is not None:
+            init_hook()
+        return trainable(process_id)
+
+    pool = ActorPool(num_processes, env_per_worker=[dict(env or {})
+                                                    for _ in range(num_processes)])
+    try:
+        futures = pool.execute_per_worker(
+            worker_body, [(i,) for i in range(num_processes)])
+        return process_results(futures, queue)
+    except BaseException:
+        # a crashed rank leaves its peers blocked in the distributed
+        # barrier; they will never drain a shutdown sentinel -- kill
+        pool.kill()
+        raise
+    finally:
+        pool.shutdown()

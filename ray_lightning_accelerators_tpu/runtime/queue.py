@@ -64,12 +64,26 @@ def process_results(futures: List[Future], q: Optional[TrampolineQueue],
                     poll_s: float = 0.01) -> List[Any]:
     """Poll training futures while draining the trampoline queue; final drain
     after completion closes the enqueue/finish race
-    (reference: util.py:96-109)."""
+    (reference: util.py:96-109).
+
+    Fails FAST on the first errored future (the ray.get-on-ready semantics,
+    reference: util.py:103): in a collective job one crashed rank leaves its
+    peers blocked in a barrier forever, so waiting for all futures would
+    hang the driver with the failure already in hand.
+    """
     pending = list(futures)
     while pending:
         drain_queue(q)
-        pending = [f for f in pending if not f.done()]
+        still = []
+        for f in pending:
+            if f.done():
+                if f.exception() is not None:
+                    drain_queue(q)
+                    f.result()  # re-raise
+            else:
+                still.append(f)
+        pending = still
         if pending:
             time.sleep(poll_s)
     drain_queue(q)
-    return [f.result() for f in futures]  # re-raises worker exceptions
+    return [f.result() for f in futures]

@@ -157,3 +157,35 @@ def test_mesh_config_inference():
     assert sizes[mesh_lib.DATA_AXIS] == 4
     with pytest.raises(ValueError):
         mesh_lib.MeshConfig(data=3, tensor=2).axis_sizes(8)
+
+
+def test_fsdp_with_grad_accum_shards_moments(tmpdir):
+    """optax.MultiSteps must not silently break optimizer-state sharding
+    (tree_map_params path through the wrapper)."""
+    from ray_lightning_accelerators_tpu import Trainer
+
+    class WideModel(BoringModel):
+        def init_params(self, rng):
+            return {"k": jax.random.normal(rng, (256, 256)) * 0.05}
+
+        def forward(self, params, x):
+            pad = jax.numpy.zeros((x.shape[0], 224))
+            return jax.numpy.concatenate([x, pad], -1) @ params["k"]
+
+        def training_step(self, params, batch, rng):
+            return jax.numpy.mean((self.forward(params, batch) - 1.0) ** 2)
+
+        def validation_step(self, params, batch):
+            return {"val_loss": jax.numpy.asarray(1.0)}
+
+    trainer = Trainer(default_root_dir=str(tmpdir), max_epochs=1,
+                      accelerator=RayTPUAccelerator(8, use_fsdp=True),
+                      accumulate_grad_batches=2, precision="f32", seed=0,
+                      enable_checkpointing=False)
+    train, val = boring_loaders(batch_size=8)
+    trainer.fit(WideModel(), train, val)
+    moments = [l for l in jax.tree.leaves(trainer._state.opt_state)
+               if hasattr(l, "shape") and l.shape == (256, 256)]
+    assert moments, "no param-shaped optimizer moments found"
+    assert all(not m.sharding.is_fully_replicated for m in moments), \
+        "optimizer moments replicated -- FSDP memory savings lost"
