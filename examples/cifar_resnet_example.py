@@ -1,0 +1,52 @@
+"""CIFAR-10 ResNet-18 training example -- BASELINE config #3
+("RayTPUAccelerator num_hosts=2 num_workers=8, CIFAR-10 ResNet18").
+
+Single-host it data-shards over all visible chips; on a pod slice the same
+script runs per-host under `runtime.bootstrap` and the mesh spans hosts
+(DCN) x chips (ICI).  CLI mirrors the reference example's flags
+(reference: examples/ray_ddp_example.py:118-150)."""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable as a script from anywhere
+from ray_lightning_accelerators_tpu import RayTPUAccelerator, Trainer
+from ray_lightning_accelerators_tpu.models.resnet import (CIFAR10DataModule,
+                                                          ResNet18)
+
+
+def train_cifar(config, num_epochs=10, num_workers=None, use_fsdp=False,
+                smoke=False):
+    model = ResNet18(config)
+    dm = CIFAR10DataModule(batch_size=config.get("batch_size", 256),
+                           n_train=1024 if smoke else 50000,
+                           n_val=256 if smoke else 10000)
+    trainer = Trainer(max_epochs=num_epochs,
+                      accelerator=RayTPUAccelerator(num_workers=num_workers,
+                                                    use_fsdp=use_fsdp),
+                      precision="bf16",
+                      default_root_dir=os.path.join(tempfile.gettempdir(),
+                                                    "rla_tpu_cifar"),
+                      enable_progress_bar=True)
+    trainer.fit(model, datamodule=dm)
+    print("final metrics:", trainer.callback_metrics)
+    return trainer
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=None,
+                        help="data-parallel shards (default: all devices)")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--use-fsdp", action="store_true")
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    train_cifar({"lr": args.lr, "batch_size": args.batch_size},
+                num_epochs=1 if args.smoke_test else args.num_epochs,
+                num_workers=args.num_workers, use_fsdp=args.use_fsdp,
+                smoke=args.smoke_test)

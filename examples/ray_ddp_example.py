@@ -5,8 +5,11 @@ over the device mesh instead of spawning DDP actors."""
 
 import argparse
 import os
+import sys
 import tempfile
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable as a script from anywhere
 from ray_lightning_accelerators_tpu import (RayTPUAccelerator, Trainer,
                                             TuneReportCallback, tune)
 from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,

@@ -4,10 +4,13 @@ the dataset on every node :24-39)."""
 
 import argparse
 import os
+import sys
 import tempfile
 
 from filelock import FileLock
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable as a script from anywhere
 from ray_lightning_accelerators_tpu import (RayTPUAccelerator, Trainer,
                                             TuneReportCallback, tune)
 from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,

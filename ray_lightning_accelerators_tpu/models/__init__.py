@@ -1,0 +1,28 @@
+"""Model families: the benchmark/example models the framework ships.
+
+- ``mnist``       -- MNISTClassifier MLP (reference's example model,
+  examples/ray_ddp_example.py:18-59).
+- ``resnet``      -- CIFAR-10 ResNet-18 (BASELINE config #3).
+- ``transformer`` -- flagship GPT for the parallelism stack.
+
+Re-exports are lazy (PEP 562) so importing one family does not pay for the
+others (the transformer pulls in the whole parallelism stack).
+"""
+
+_EXPORTS = {
+    "MNISTClassifier": "mnist", "MNISTDataModule": "mnist",
+    "synthetic_mnist": "mnist",
+    "ResNet18": "resnet", "CIFAR10DataModule": "resnet",
+    "synthetic_cifar10": "resnet",
+    "GPT": "transformer", "TransformerConfig": "transformer",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

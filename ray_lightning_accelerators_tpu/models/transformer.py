@@ -196,8 +196,6 @@ class GPT(TpuModule):
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
         dt = self.compute_dtype
-        b, s = tokens.shape
-        positions = jnp.arange(s)
         h = params["embed"].astype(dt)[tokens]
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)

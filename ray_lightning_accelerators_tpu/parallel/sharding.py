@@ -108,7 +108,9 @@ def shard_constraint(x, mesh: Mesh, spec: P):
     specs still raise instead of silently no-op'ing.
     """
     ambient = jax.sharding.get_abstract_mesh()
-    if not ambient.empty and ambient._any_axis_manual:
+    # `_any_axis_manual` is private jax API (0.9.x); degrade to the plain-jit
+    # path if a future jax renames it rather than crashing every forward
+    if not ambient.empty and getattr(ambient, "_any_axis_manual", False):
         return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

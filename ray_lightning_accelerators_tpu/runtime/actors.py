@@ -87,7 +87,14 @@ class Worker:
             daemon=True, name=f"rla-tpu-worker-{rank}")
         self._proc.start()
         child_conn.close()
-        self._lock = threading.Lock()
+        # Two locks: _state_lock guards _pending (held only for list ops, so
+        # the collector can always drain the pipe even while a sender is
+        # blocked on a full pipe buffer -- holding one lock across a blocking
+        # send_bytes can three-way-deadlock driver sender / collector /
+        # worker); _send_lock serializes senders so _pending order matches
+        # wire order.
+        self._state_lock = threading.Lock()
+        self._send_lock = threading.Lock()
         self._pending: List[Future] = []
         self._collector = threading.Thread(target=self._collect, daemon=True)
         self._collector.start()
@@ -97,17 +104,20 @@ class Worker:
         """Ship fn to the worker; returns a Future (ObjectRef analog)."""
         fut: Future = Future()
         blob = cloudpickle.dumps((fn, args, kwargs))
-        with self._lock:
+        with self._send_lock:
             if not self._proc.is_alive():
                 fut.set_exception(RuntimeError(
                     f"worker {self.rank} is dead"))
                 return fut
-            self._pending.append(fut)
+            with self._state_lock:
+                self._pending.append(fut)
             try:
-                self._conn.send_bytes(blob)
+                self._conn.send_bytes(blob)  # may block; collector still runs
             except (BrokenPipeError, OSError) as e:
                 # worker died between the liveness check and the send
-                self._pending.remove(fut)
+                with self._state_lock:
+                    if fut in self._pending:
+                        self._pending.remove(fut)
                 fut.set_exception(RuntimeError(
                     f"worker {self.rank} died before accepting work: {e}"))
         return fut
@@ -117,7 +127,7 @@ class Worker:
             try:
                 blob = self._conn.recv_bytes()
             except (EOFError, OSError):
-                with self._lock:
+                with self._state_lock:
                     pending, self._pending = self._pending, []
                 for fut in pending:
                     if not fut.done():
@@ -125,7 +135,7 @@ class Worker:
                             f"worker {self.rank} died "
                             f"(exitcode={self._proc.exitcode})"))
                 return
-            with self._lock:
+            with self._state_lock:
                 fut = self._pending.pop(0)
             try:
                 status, payload = cloudpickle.loads(blob)
@@ -157,7 +167,7 @@ class Worker:
 
     def shutdown(self, timeout: float = 10.0) -> None:
         try:
-            with self._lock:
+            with self._send_lock:
                 self._conn.send_bytes(_SENTINEL)
             self._proc.join(timeout=timeout)
         except (BrokenPipeError, OSError):

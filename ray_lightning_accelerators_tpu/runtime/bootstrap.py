@@ -18,7 +18,7 @@ from __future__ import annotations
 import socket
 from typing import Any, Callable, Dict, List, Optional
 
-from .actors import ActorPool
+from .actors import ActorPool, RemoteError
 from .queue import TrampolineQueue, process_results
 
 
@@ -60,26 +60,40 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
                        queue: Optional[TrampolineQueue] = None) -> List[Any]:
     """Fan `trainable(process_id)` over num_processes fresh processes, each
     with a jax.distributed world formed first.  Returns per-rank results,
-    rank 0 first."""
-    coord = pick_coordinator_address()
+    rank 0 first.
 
-    def worker_body(process_id: int) -> Any:
-        initialize_worker(coord, num_processes, process_id, platform,
-                          cpu_devices_per_process)
-        if init_hook is not None:
-            init_hook()
-        return trainable(process_id)
+    The probe-then-close port pick in ``pick_coordinator_address`` has an
+    inherent reuse window (another process can claim the freed port before
+    rank 0's coordinator binds it); a bind failure is retried with a fresh
+    port rather than surfacing as an unattributable rendezvous hang.
+    """
+    for attempt in range(3):
+        coord = pick_coordinator_address()
 
-    pool = ActorPool(num_processes, env_per_worker=[dict(env or {})
-                                                    for _ in range(num_processes)])
-    try:
-        futures = pool.execute_per_worker(
-            worker_body, [(i,) for i in range(num_processes)])
-        return process_results(futures, queue)
-    except BaseException:
-        # a crashed rank leaves its peers blocked in the distributed
-        # barrier; they will never drain a shutdown sentinel -- kill
-        pool.kill()
-        raise
-    finally:
-        pool.shutdown()
+        def worker_body(process_id: int, coord=coord) -> Any:
+            initialize_worker(coord, num_processes, process_id, platform,
+                              cpu_devices_per_process)
+            if init_hook is not None:
+                init_hook()
+            return trainable(process_id)
+
+        pool = ActorPool(num_processes,
+                         env_per_worker=[dict(env or {})
+                                         for _ in range(num_processes)])
+        try:
+            futures = pool.execute_per_worker(
+                worker_body, [(i,) for i in range(num_processes)])
+            return process_results(futures, queue)
+        except RemoteError as e:
+            pool.kill()
+            bindy = any(tok in str(e).lower()
+                        for tok in ("bind", "address already in use"))
+            if not (bindy and attempt < 2):
+                raise
+        except BaseException:
+            # a crashed rank leaves its peers blocked in the distributed
+            # barrier; they will never drain a shutdown sentinel -- kill
+            pool.kill()
+            raise
+        finally:
+            pool.shutdown()

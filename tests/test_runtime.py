@@ -33,6 +33,23 @@ def _pid():
     return os.getpid()
 
 
+def _echo_big(arr):
+    return arr * 2
+
+
+def test_large_payloads_do_not_deadlock():
+    """Requests/results far beyond the OS pipe buffer (~64KiB) must flow
+    while earlier results are still in flight (regression: a single lock held
+    across a blocking send could three-way-deadlock sender/collector/worker).
+    """
+    big = np.ones(1_000_000, dtype=np.float32)  # ~4MB each way
+    with ActorPool(1) as pool:
+        w = pool.workers[0]
+        futs = [w.execute(_echo_big, big) for _ in range(4)]
+        for f in futs:
+            np.testing.assert_array_equal(f.result(timeout=60), big * 2)
+
+
 def test_pool_executes_in_parallel_processes():
     with ActorPool(2) as pool:
         futs = pool.execute_all(_pid)
