@@ -47,6 +47,9 @@ class RandomDataset(Dataset):
     def __getitem__(self, idx: int):
         return self.data[idx]
 
+    def _native_arrays(self):
+        return (self.data,)
+
 
 class ArrayDataset(Dataset):
     """Zips equal-length arrays into (a[i], b[i], ...) examples."""
@@ -61,6 +64,9 @@ class ArrayDataset(Dataset):
     def __getitem__(self, idx: int):
         items = tuple(a[idx] for a in self.arrays)
         return items if len(items) > 1 else items[0]
+
+    def _native_arrays(self):
+        return self.arrays
 
 
 class ShardedSampler:
@@ -129,16 +135,20 @@ class DataLoader:
                  shuffle: bool = False, sampler: Optional[ShardedSampler] = None,
                  drop_last: bool = True,
                  collate_fn: Callable[[Sequence[Any]], Any] = default_collate,
-                 seed: int = 0):
+                 seed: int = 0, use_native: Optional[bool] = None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.collate_fn = collate_fn
         self.seed = seed
+        self.use_native = use_native
         self._user_set_sampler = sampler is not None
         self.sampler = sampler or ShardedSampler(
             len(dataset), 1, 0, shuffle=shuffle, drop_last=drop_last, seed=seed)
+        self._engine = None  # lazily-built native.DataEngine
+        self._engine_key = None
+        self._engine_busy = False
 
     def _inject_sampler(self, num_replicas: int, rank: int,
                         shuffle: bool) -> None:
@@ -157,6 +167,18 @@ class DataLoader:
             n / self.batch_size)
 
     def __iter__(self) -> Iterator[Any]:
+        engine = self._native_engine()
+        if engine is not None:
+            # single-consumer engine: while this generator is live, further
+            # iterators (zip(loader, loader), nested passes) take the Python
+            # path instead of resetting this one's stream
+            self._engine_busy = True
+            try:
+                indices = np.fromiter(self.sampler, np.int64)
+                yield from engine.iter_indices(indices)
+                return
+            finally:
+                self._engine_busy = False
         buf = []
         for idx in self.sampler:
             buf.append(self.dataset[idx])
@@ -165,3 +187,50 @@ class DataLoader:
                 buf = []
         if buf and not self.drop_last:
             yield self.collate_fn(buf)
+
+    # ------------------------------------------------------------------ #
+    # native fast path                                                   #
+    # ------------------------------------------------------------------ #
+    def _native_engine(self):
+        """C++ batch engine when the dataset is array-backed; None otherwise
+        (Python path).  Batches are bit-identical either way: the engine
+        consumes THIS loader's sampler index order and only parallelizes the
+        gather/collate off the GIL, prefetching ahead of consumption to
+        overlap input with async XLA dispatch (SURVEY.md §7.4 flags the
+        input pipeline as the TPU bottleneck)."""
+        def ineligible(reason: str):
+            if self.use_native:
+                raise RuntimeError(f"use_native=True but {reason}")
+            return None
+
+        if self.use_native is False:
+            return None
+        if getattr(self, "_engine_busy", False):
+            return None  # re-entrant iteration: concurrent pass uses Python
+        if self.collate_fn is not default_collate:
+            return ineligible("a custom collate_fn is set")
+        arrays = getattr(self.dataset, "_native_arrays", lambda: None)()
+        from .. import native
+        if not arrays or not native.engine_compatible_arrays(arrays):
+            return ineligible(
+                "the dataset does not expose numeric _native_arrays()")
+        if not native.available():
+            return ineligible(str(native.build_error()))
+        key = (self.batch_size, self.drop_last)
+        if self._engine is None or self._engine_key != key:
+            if self._engine is not None:
+                self._engine.close()
+            self._engine = native.DataEngine(
+                arrays, self.batch_size, drop_last=self.drop_last)
+            self._engine_key = key
+        return self._engine
+
+    def __getstate__(self):
+        # the native engine holds ctypes handles + threads; rebuild on the
+        # far side (loaders ship to workers through cloudpickle, the analog
+        # of the reference's ray.put'd Trainer, ray_ddp.py:169)
+        state = self.__dict__.copy()
+        state["_engine"] = None
+        state["_engine_key"] = None
+        state["_engine_busy"] = False
+        return state
