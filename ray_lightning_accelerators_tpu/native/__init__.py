@@ -91,8 +91,27 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.rla_engine_num_batches.restype = ctypes.c_long
         lib.rla_engine_num_batches.argtypes = [ctypes.c_void_p]
         lib.rla_engine_destroy.argtypes = [ctypes.c_void_p]
+        lib.rla_shm_create.restype = ctypes.c_void_p
+        lib.rla_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.rla_shm_open_ro.restype = ctypes.c_void_p
+        lib.rla_shm_open_ro.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_long)]
+        lib.rla_shm_unmap.restype = ctypes.c_int
+        lib.rla_shm_unmap.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.rla_shm_unlink.restype = ctypes.c_int
+        lib.rla_shm_unlink.argtypes = [ctypes.c_char_p]
+        lib.rla_shm_errno.restype = ctypes.c_int
+        lib.rla_shm_errno.argtypes = []
         _LIB = lib
         return _LIB
+
+
+def lib() -> ctypes.CDLL:
+    """The loaded native library; raises when unavailable."""
+    loaded = _load()
+    if loaded is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    return loaded
 
 
 def available() -> bool:

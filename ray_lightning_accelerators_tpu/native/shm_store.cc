@@ -1,0 +1,89 @@
+// POSIX shared-memory object store: the in-repo analog of the plasma store
+// the reference reaches through ray.put / ray.get (reference:
+// ray_lightning/ray_ddp.py:169 ships the whole pickled Trainer via Ray's
+// object store; SURVEY.md §2.3 maps Ray core's native layer to this).
+//
+// The driver `put`s large tensors into named shm segments; spawn workers on
+// the same host map them by name — no pickle bytes through actor pipes, no
+// double copy.  Python (runtime/object_store.py) owns naming, pytree
+// structure, and lifecycle; this layer is just create/map/unlink.
+
+#include <cerrno>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+thread_local int g_errno = 0;
+}
+
+extern "C" {
+
+int rla_shm_errno() { return g_errno; }
+
+// Create a segment of nbytes and return a writable mapping (NULL on error).
+// Fails with EEXIST rather than silently reusing a name.
+void* rla_shm_create(const char* name, long nbytes) {
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    g_errno = errno;
+    return nullptr;
+  }
+  if (ftruncate(fd, nbytes) != 0) {
+    g_errno = errno;
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, nbytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);  // mapping keeps the segment alive
+  if (p == MAP_FAILED) {
+    g_errno = errno;
+    shm_unlink(name);
+    return nullptr;
+  }
+  return p;
+}
+
+// Map an existing segment read-only; writes its size to *size_out.
+void* rla_shm_open_ro(const char* name, long* size_out) {
+  int fd = shm_open(name, O_RDONLY, 0);
+  if (fd < 0) {
+    g_errno = errno;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    g_errno = errno;
+    close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) {
+    g_errno = errno;
+    return nullptr;
+  }
+  *size_out = (long)st.st_size;
+  return p;
+}
+
+int rla_shm_unmap(void* ptr, long nbytes) {
+  if (munmap(ptr, nbytes) != 0) {
+    g_errno = errno;
+    return -1;
+  }
+  return 0;
+}
+
+int rla_shm_unlink(const char* name) {
+  if (shm_unlink(name) != 0) {
+    g_errno = errno;
+    return -1;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -57,6 +57,12 @@ def _worker_main(conn, env: Dict[str, str]) -> None:
             return
         try:
             fn, args, kwargs = cloudpickle.loads(blob)
+            # Ray-style call-site deref: top-level ObjectRef args resolve
+            # from the shared-memory store (reference: ray.put'd trainer_ref
+            # arriving deserialized at ray_ddp.py:179,201)
+            from .object_store import resolve
+            args = tuple(resolve(a) for a in args)
+            kwargs = {k: resolve(v) for k, v in kwargs.items()}
             result = fn(*args, **kwargs)
             payload = ("ok", cloudpickle.dumps(result))
         except BaseException as e:  # ship the traceback home

@@ -1,0 +1,227 @@
+"""Shared-memory object store: put/get large pytrees across host processes.
+
+Capability analog of the reference's use of Ray's plasma object store —
+``trainer_ref = ray.put(trainer)`` then every worker dereferences it
+(reference: ray_lightning/ray_ddp.py:169-182, ray_horovod.py:124,148).
+There, big payloads move through Ray's C++ store instead of being pickled
+per-actor; here, numpy leaves above a size threshold go into POSIX shm
+segments (native/shm_store.cc) that spawn workers on the same host map by
+name, so N workers share one copy instead of N pickled copies through actor
+pipes.
+
+Driver-side lifecycle: the creating store owns its segments and unlinks them
+on ``delete``/``shutdown``/exit.  ``ObjectRef`` itself is a small picklable
+handle (segment names + pytree structure) that ships through the normal
+actor channel; workers resolve it with ``get`` (``runtime.actors`` does this
+automatically for top-level arguments, mirroring Ray's call-site deref).
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import errno
+import os
+import secrets
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+import numpy as np
+
+from .. import native
+
+DEFAULT_THRESHOLD = 64 * 1024  # leaves smaller than this stay inline
+
+
+class _Placeholder:
+    """Stand-in for a shm-backed leaf inside the pickled pytree."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """Picklable handle on a stored object (the ray.ObjectRef analog)."""
+
+    object_id: str
+    # per shm leaf: (segment name, dtype string, shape)
+    segments: Tuple[Tuple[str, str, Tuple[int, ...]], ...]
+    payload: bytes = field(repr=False)  # cloudpickled tree w/ placeholders
+
+    def total_shm_bytes(self) -> int:
+        return sum(int(np.dtype(d).itemsize) * int(np.prod(s, dtype=np.int64))
+                   for _, d, s in self.segments)
+
+
+class ObjectStoreError(RuntimeError):
+    pass
+
+
+def _check_errno(action: str, name: str) -> "ObjectStoreError":
+    err = native.lib().rla_shm_errno()
+    if err == errno.ENOENT:
+        return ObjectStoreError(
+            f"{action} {name!r}: segment does not exist (already deleted, "
+            f"or put on a different host — shm is per-host like plasma)")
+    return ObjectStoreError(f"{action} {name!r}: {os.strerror(err)}")
+
+
+class ObjectStore:
+    """Put/get pytrees; large numpy leaves ride shared memory."""
+
+    def __init__(self, threshold: int = DEFAULT_THRESHOLD):
+        self.threshold = threshold
+        self._lock = threading.Lock()
+        self._owned: Dict[str, List[str]] = {}  # object_id -> segment names
+        self._mappings: List[Tuple[int, int]] = []  # zero-copy (ptr, nbytes)
+        self._prefix = f"/rla-{os.getpid()}-{secrets.token_hex(4)}"
+        self._counter = 0
+        atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------ #
+    def put(self, obj: Any) -> ObjectRef:
+        import jax
+
+        lib = native.lib()
+        leaves, treedef = jax.tree_util.tree_flatten(obj)
+        with self._lock:
+            self._counter += 1
+            object_id = f"{self._prefix}-{self._counter}"
+        segments: List[Tuple[str, str, Tuple[int, ...]]] = []
+        names: List[str] = []
+        out_leaves: List[Any] = []
+        try:
+            for leaf in leaves:
+                arr = None
+                if isinstance(leaf, np.ndarray):
+                    arr = leaf
+                elif isinstance(leaf, jax.Array):
+                    arr = np.asarray(leaf)  # device -> host once, here
+                if (arr is None or arr.dtype.hasobject
+                        or arr.nbytes < self.threshold):
+                    out_leaves.append(arr if arr is not None else leaf)
+                    continue
+                arr = np.ascontiguousarray(arr)
+                name = f"{object_id}-{len(segments)}"
+                ptr = lib.rla_shm_create(name.encode(), arr.nbytes)
+                if not ptr:
+                    raise _check_errno("create", name)
+                dst = np.frombuffer(
+                    (ctypes.c_char * arr.nbytes).from_address(ptr),
+                    dtype=arr.dtype).reshape(arr.shape)
+                np.copyto(dst, arr)
+                del dst
+                lib.rla_shm_unmap(ptr, arr.nbytes)
+                out_leaves.append(_Placeholder(len(segments)))
+                segments.append((name, arr.dtype.str, tuple(arr.shape)))
+                names.append(name)
+        except BaseException:
+            for n in names:
+                lib.rla_shm_unlink(n.encode())
+            raise
+        payload = cloudpickle.dumps(
+            jax.tree_util.tree_unflatten(treedef, out_leaves))
+        with self._lock:
+            self._owned[object_id] = names
+        return ObjectRef(object_id, tuple(segments), payload)
+
+    # ------------------------------------------------------------------ #
+    def get(self, ref: ObjectRef, copy: bool = True) -> Any:
+        """Materialize a stored object.
+
+        ``copy=True`` (default) returns independent arrays.  ``copy=False``
+        returns read-only views into the mapped segments — zero-copy, valid
+        until this store is shut down (mappings are retained by the store).
+        """
+        import jax
+
+        lib = native.lib()
+        arrays: List[np.ndarray] = []
+        for name, dtype_str, shape in ref.segments:
+            size_out = ctypes.c_long()
+            ptr = lib.rla_shm_open_ro(name.encode(), ctypes.byref(size_out))
+            if not ptr:
+                raise _check_errno("open", name)
+            nbytes = size_out.value
+            view = np.frombuffer(
+                (ctypes.c_char * nbytes).from_address(ptr),
+                dtype=np.dtype(dtype_str)).reshape(shape)
+            view.flags.writeable = False
+            if copy:
+                arrays.append(view.copy())
+                del view
+                lib.rla_shm_unmap(ptr, nbytes)
+            else:
+                with self._lock:
+                    self._mappings.append((ptr, nbytes))
+                arrays.append(view)
+        tree = cloudpickle.loads(ref.payload)
+        return jax.tree_util.tree_map(
+            lambda l: arrays[l.index] if isinstance(l, _Placeholder) else l,
+            tree, is_leaf=lambda l: isinstance(l, _Placeholder))
+
+    # ------------------------------------------------------------------ #
+    def delete(self, ref: ObjectRef) -> None:
+        lib = native.lib()
+        with self._lock:
+            names = self._owned.pop(ref.object_id, None)
+        for name in (names if names is not None
+                     else [s[0] for s in ref.segments]):
+            lib.rla_shm_unlink(name.encode())
+
+    def shutdown(self) -> None:
+        try:
+            lib = native.lib()
+        except RuntimeError:
+            return
+        with self._lock:
+            owned = list(self._owned.values())
+            self._owned.clear()
+            mappings, self._mappings = self._mappings, []
+        for ptr, nbytes in mappings:
+            lib.rla_shm_unmap(ptr, nbytes)
+        for names in owned:
+            for name in names:
+                lib.rla_shm_unlink(name.encode())
+
+    def __enter__(self) -> "ObjectStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+# process-global store: workers resolve inbound ObjectRefs through this
+_GLOBAL: Optional[ObjectStore] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_store() -> ObjectStore:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ObjectStore()
+        return _GLOBAL
+
+
+def resolve(value: Any) -> Any:
+    """Dereference if value is an ObjectRef (Ray-style call-site deref)."""
+    if isinstance(value, ObjectRef):
+        return global_store().get(value)
+    return value
+
+
+def put(obj: Any) -> ObjectRef:
+    """``ray.put`` analog on the process-global store
+    (reference: ray_lightning/ray_ddp.py:169)."""
+    return global_store().put(obj)
+
+
+def get(ref: ObjectRef, copy: bool = True) -> Any:
+    """``ray.get`` analog on the process-global store."""
+    return global_store().get(ref, copy=copy)
