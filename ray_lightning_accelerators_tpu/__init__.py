@@ -18,6 +18,7 @@ from .data.loader import (ArrayDataset, DataLoader, Dataset, RandomDataset,
                           ShardedSampler)
 from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.session import get_actor_rank, init_session, put_queue
+from .utils.profiler import Profiler, device_memory_stats
 from . import tune
 from .tune import TuneReportCallback, TuneReportCheckpointCallback
 
@@ -32,5 +33,6 @@ __all__ = [
     "ShardedSampler",
     "MeshConfig", "build_mesh",
     "get_actor_rank", "init_session", "put_queue",
+    "Profiler", "device_memory_stats",
     "tune", "TuneReportCallback", "TuneReportCheckpointCallback",
 ]

@@ -41,6 +41,7 @@ from ..data.loader import DataLoader
 from ..parallel import mesh as mesh_lib
 from ..utils import checkpoint as ckpt_lib
 from ..utils.logging import CSVLogger, InMemoryLogger, Logger, log
+from ..utils.profiler import Profiler
 from ..utils.seed import rng_from_seed, seed_everything
 from .callbacks import Callback, ModelCheckpoint
 from .module import TpuModule
@@ -70,6 +71,7 @@ class Trainer:
                  enable_checkpointing: bool = True,
                  num_sanity_val_steps: int = 0,
                  enable_progress_bar: bool = False,
+                 profiler: Optional["Profiler"] = None,
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -95,6 +97,7 @@ class Trainer:
         self.enable_checkpointing = enable_checkpointing
         self.num_sanity_val_steps = num_sanity_val_steps
         self.enable_progress_bar = enable_progress_bar
+        self.profiler = profiler
         self.seed = seed_everything(seed)
 
         if enable_checkpointing and not any(
@@ -321,12 +324,17 @@ class Trainer:
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(self.current_epoch)
 
-            for batch_idx, batch in enumerate(train_loader):
+            for batch_idx, batch in enumerate(
+                    self._iter_profiled(train_loader)):
                 if (self.limit_train_batches is not None
                         and batch_idx >= self.limit_train_batches):
                     break
-                batch = self._put_batch(batch)
-                state, train_metrics = self._train_step_fn(state, batch)
+                with self._span("h2d"):
+                    batch = self._put_batch(batch)
+                with self._span("train_step") as h:
+                    state, train_metrics = self._train_step_fn(state, batch)
+                    if h is not None:
+                        h.set(train_metrics)
                 self.global_step += 1
                 self._state = state
                 for c in self.callbacks:
@@ -349,10 +357,11 @@ class Trainer:
             if run_val:
                 for c in self.callbacks:
                     c.on_validation_start(self, module)
-                val_metrics = self._run_eval(self._val_loader,
-                                             self._eval_step_fn,
-                                             limit=self.limit_val_batches,
-                                             prefix=None)
+                with self._span("validation"):
+                    val_metrics = self._run_eval(self._val_loader,
+                                                 self._eval_step_fn,
+                                                 limit=self.limit_val_batches,
+                                                 prefix=None)
                 self.callback_metrics.update(val_metrics)
                 self._log_now(val_metrics)
                 module.on_validation_epoch_end()
@@ -382,6 +391,29 @@ class Trainer:
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
         self.fit_duration_s = time.perf_counter() - t0
+
+    def _span(self, name: str):
+        """Profiler span, or a null context when no profiler is attached
+        (XLA async dispatch makes spans the only honest timing surface --
+        SURVEY.md §5.1 build note)."""
+        if self.profiler is not None:
+            return self.profiler.span(name)
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _iter_profiled(self, loader):
+        """Iterate a loader, timing each fetch under a 'data_fetch' span."""
+        if self.profiler is None:
+            yield from loader
+            return
+        it = iter(loader)
+        while True:
+            with self.profiler.span("data_fetch"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
 
     def _done(self) -> bool:
         if self.should_stop:

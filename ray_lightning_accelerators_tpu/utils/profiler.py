@@ -1,0 +1,188 @@
+"""Profiling/tracing subsystem.
+
+The reference has none (SURVEY.md §5.1: no profiler, no timing, no spans
+anywhere in its tree) and its build note calls for one as a first-class TPU
+subsystem: XLA's async dispatch makes naive timing and printf-debugging
+useless — a ``time.time()`` around a jitted call measures *dispatch*, not
+compute, and device work only surfaces in XLA traces.
+
+Three layers:
+
+- **Span timing** (`Profiler.span`): nested host-side wall-clock spans with
+  a thread-local stack.  Each span also opens a
+  ``jax.profiler.TraceAnnotation`` so the same names line up inside
+  TensorBoard/XProf device traces.  ``sync=True`` spans block on device work
+  (``jax.block_until_ready``) so step spans measure real compute.
+- **Device traces** (`start_trace`/`stop_trace`): wraps ``jax.profiler`` to
+  dump an XPlane/TensorBoard trace directory.
+- **Device memory** (`device_memory_stats`): PjRt per-device HBM counters.
+
+The Trainer takes ``profiler=`` and wraps its hot phases
+(data fetch / train step / validation) in spans; see core/trainer.py.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+class _SpanHandle:
+    """Mutable holder for a span's device outputs (see Profiler.span)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, value: Any) -> None:
+        self.value = value
+
+
+class _SpanStat:
+    __slots__ = ("count", "total", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.samples: List[float] = []  # capped reservoir for percentiles
+
+    def add(self, dt: float, cap: int = 4096) -> None:
+        self.count += 1
+        self.total += dt
+        if len(self.samples) < cap:
+            self.samples.append(dt)
+
+
+class Profiler:
+    """Named nested wall-clock spans + XLA trace annotations."""
+
+    def __init__(self, sync: bool = False):
+        """``sync=True``: spans wrapping device work block until it finishes,
+        so durations measure compute rather than async dispatch."""
+        self.sync = sync
+        self._stats: Dict[str, _SpanStat] = {}
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._trace_dir: Optional[str] = None
+
+    # ------------------------------------------------------------------ #
+    def _stack(self) -> List[str]:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    @contextmanager
+    def span(self, name: str):
+        """Time a block under `name`, nested as parent/child in the report.
+
+        Yields a handle; call ``handle.set(outputs)`` with the block's device
+        outputs and a sync-mode profiler will block on them before closing,
+        so the span measures compute rather than async dispatch."""
+        import jax
+
+        handle = _SpanHandle()
+        stack = self._stack()
+        full = "/".join(stack + [name])
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(name):
+                yield handle
+                if self.sync and handle.value is not None:
+                    jax.block_until_ready(handle.value)
+        finally:
+            dt = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                self._stats.setdefault(full, _SpanStat()).add(dt)
+
+    # ------------------------------------------------------------------ #
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """name -> {count, total_s, mean_s, p50_s, p95_s}."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            items = list(self._stats.items())
+        for name, st in items:
+            xs = sorted(st.samples)
+            pick = (lambda q: xs[min(len(xs) - 1,
+                                     int(math.ceil(q * len(xs))) - 1)]
+                    if xs else 0.0)
+            out[name] = {
+                "count": st.count,
+                "total_s": st.total,
+                "mean_s": st.total / max(st.count, 1),
+                "p50_s": pick(0.50),
+                "p95_s": pick(0.95),
+            }
+        return out
+
+    def describe(self) -> str:
+        """Human-readable table, longest total first."""
+        rows = sorted(self.summary().items(),
+                      key=lambda kv: -kv[1]["total_s"])
+        lines = [f"{'span':<40} {'count':>7} {'total':>9} {'mean':>9} "
+                 f"{'p50':>9} {'p95':>9}"]
+        for name, s in rows:
+            lines.append(
+                f"{name:<40} {s['count']:>7d} {s['total_s']:>8.3f}s "
+                f"{s['mean_s'] * 1e3:>7.2f}ms {s['p50_s'] * 1e3:>7.2f}ms "
+                f"{s['p95_s'] * 1e3:>7.2f}ms")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    # ------------------------------------------------------------------ #
+    # Device traces (TensorBoard / XProf)                                #
+    # ------------------------------------------------------------------ #
+    def start_trace(self, log_dir: str) -> None:
+        """Begin an XPlane device trace (view in TensorBoard's profiler)."""
+        import jax
+
+        if self._trace_dir is not None:
+            raise RuntimeError(f"trace already running -> {self._trace_dir}")
+        jax.profiler.start_trace(log_dir)
+        self._trace_dir = log_dir
+
+    def stop_trace(self) -> Optional[str]:
+        import jax
+
+        if self._trace_dir is None:
+            return None
+        jax.profiler.stop_trace()
+        d, self._trace_dir = self._trace_dir, None
+        return d
+
+    @contextmanager
+    def trace(self, log_dir: str):
+        self.start_trace(log_dir)
+        try:
+            yield
+        finally:
+            self.stop_trace()
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device PjRt memory counters (bytes_in_use, peak, limit...).
+
+    Empty dicts on backends that don't expose stats (CPU)."""
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        try:
+            out.append(dict(d.memory_stats() or {}))
+        except Exception:
+            out.append({})
+    return out
+
+
+class PassThroughProfiler(Profiler):
+    """No-op-ish default: spans still count, but with sync off and no
+    annotations overhead beyond TraceAnnotation's cheap enter/exit."""
+    pass

@@ -1,0 +1,96 @@
+"""Profiler subsystem: spans, sync mode, summaries, trainer integration,
+device traces (the first-class tracing subsystem SURVEY.md §5.1 calls for —
+the reference has none)."""
+
+import glob
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_lightning_accelerators_tpu import (Profiler, RayTPUAccelerator,
+                                            Trainer, device_memory_stats)
+from tests.utils import BoringModel, boring_loaders
+
+
+def test_spans_nest_and_count():
+    prof = Profiler()
+    for _ in range(3):
+        with prof.span("outer"):
+            with prof.span("inner"):
+                time.sleep(0.001)
+    s = prof.summary()
+    assert s["outer"]["count"] == 3
+    assert s["outer/inner"]["count"] == 3
+    assert s["outer"]["total_s"] >= s["outer/inner"]["total_s"] > 0
+    for k in ("count", "total_s", "mean_s", "p50_s", "p95_s"):
+        assert k in s["outer"]
+    assert "outer/inner" in prof.describe()
+    prof.reset()
+    assert prof.summary() == {}
+
+
+def test_sync_span_blocks_on_device_outputs():
+    prof = Profiler(sync=True)
+
+    @jax.jit
+    def work(x):
+        for _ in range(20):
+            x = x @ x
+        return x
+
+    x = jnp.ones((512, 512)) * 0.001
+    work(x).block_until_ready()  # compile outside the span
+    with prof.span("dispatch_only"):
+        y = work(x)
+    y.block_until_ready()
+    with prof.span("synced") as h:
+        h.set(work(x))
+    s = prof.summary()
+    # the synced span includes device compute; dispatch-only does not
+    assert s["synced"]["total_s"] >= s["dispatch_only"]["total_s"]
+
+
+def test_trainer_profiler_integration():
+    prof = Profiler()
+    train, val = boring_loaders()
+    trainer = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False,
+                      profiler=prof, log_every_n_steps=10 ** 9, seed=0)
+    trainer.fit(BoringModel(), train, val)
+    s = prof.summary()
+    assert s["train_step"]["count"] == trainer.global_step > 0
+    assert s["data_fetch"]["count"] >= trainer.global_step
+    assert s["h2d"]["count"] == trainer.global_step
+    assert s["validation"]["count"] == 2
+
+
+def test_device_trace_roundtrip(tmp_path):
+    prof = Profiler()
+    log_dir = str(tmp_path / "trace")
+    with prof.trace(log_dir):
+        jnp.ones((64, 64)).sum().block_until_ready()
+    produced = glob.glob(os.path.join(log_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in produced), produced
+    # a second trace works after the first closed
+    with prof.trace(str(tmp_path / "trace2")):
+        pass
+
+
+def test_trace_double_start_raises(tmp_path):
+    prof = Profiler()
+    prof.start_trace(str(tmp_path / "t"))
+    try:
+        with pytest.raises(RuntimeError, match="already running"):
+            prof.start_trace(str(tmp_path / "t2"))
+    finally:
+        prof.stop_trace()
+    assert prof.stop_trace() is None  # idempotent
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    assert len(stats) == len(jax.local_devices())
+    assert all(isinstance(d, dict) for d in stats)
