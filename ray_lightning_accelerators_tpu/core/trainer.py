@@ -106,6 +106,7 @@ class Trainer:
 
         # run state
         self.current_epoch = 0
+        self.epochs_completed = 0
         self.global_step = 0
         self.should_stop = False
         self.sanity_checking = False
@@ -135,8 +136,11 @@ class Trainer:
             st = c.state_dict()
             if st:
                 cb_states[c.state_key] = st
+        # the stored epoch counts COMPLETED epochs (maintained by the fit
+        # loop; a max_steps-truncated epoch does not count), so a resumed run
+        # neither repeats the epoch that produced the save nor skips ahead
         payload = ckpt_lib.build_checkpoint(
-            self._state, self.current_epoch, self.global_step,
+            self._state, self.epochs_completed, self.global_step,
             hparams=getattr(self.module, "hparams", {}), callbacks=cb_states)
         if self.module is not None:
             self.module.on_save_checkpoint(payload)
@@ -152,6 +156,7 @@ class Trainer:
         payload = ckpt_lib.read_checkpoint(ckpt_path)
         state = ckpt_lib.restore_state(payload, state)
         self.current_epoch = payload["epoch"]
+        self.epochs_completed = payload["epoch"]
         self.global_step = payload["global_step"]
         for c in self.callbacks:
             if c.state_key in payload.get("callbacks", {}):
@@ -256,6 +261,7 @@ class Trainer:
         self.fitting = True
         self.should_stop = False
         self.current_epoch = 0
+        self.epochs_completed = 0
         self.global_step = 0
         self.module = module
         module.trainer = self
@@ -295,6 +301,14 @@ class Trainer:
         state = TrainState.create(init_params, self._tx, state_rng)
         for c in self.callbacks:
             c.setup(self, module, "fit")
+        if ckpt_path == "last":
+            # crash-recovery anchor: resume from the newest checkpoint under
+            # the run dir, or start fresh when none exists yet (capability
+            # the reference lacks, SURVEY.md §5.4)
+            ckpt_path = ckpt_lib.latest_checkpoint(self.default_root_dir)
+            if ckpt_path is None:
+                log.warning("ckpt_path='last': no checkpoint under %s; "
+                            "starting fresh", self.default_root_dir)
         if ckpt_path is not None:
             state = self._restore(ckpt_path, state)
 
@@ -345,6 +359,15 @@ class Trainer:
                 if self.max_steps and self.global_step >= self.max_steps:
                     self.should_stop = True
                     break
+            else:
+                # epoch ran to the end of its loader (a max_steps break
+                # leaves the epoch incomplete for checkpoint accounting;
+                # limit_train_batches redefines the epoch, handled above by
+                # `break` too -- treat it as complete)
+                self.epochs_completed = self.current_epoch + 1
+            if (self.limit_train_batches is not None
+                    and not self.should_stop):
+                self.epochs_completed = self.current_epoch + 1
 
             # harvest train metrics for callback_metrics at epoch boundary
             if train_metrics:

@@ -86,13 +86,8 @@ class Worker:
     def __init__(self, rank: int, env: Optional[Dict[str, str]] = None,
                  ctx: Optional[Any] = None):
         self.rank = rank
-        ctx = ctx or mp.get_context("spawn")
-        self._conn, child_conn = ctx.Pipe()
-        self._proc = ctx.Process(
-            target=_worker_main, args=(child_conn, dict(env or {})),
-            daemon=True, name=f"rla-tpu-worker-{rank}")
-        self._proc.start()
-        child_conn.close()
+        self._env = dict(env or {})  # kept for restart()
+        self._ctx = ctx or mp.get_context("spawn")
         # Two locks: _state_lock guards _pending (held only for list ops, so
         # the collector can always drain the pipe even while a sender is
         # blocked on a full pipe buffer -- holding one lock across a blocking
@@ -101,9 +96,53 @@ class Worker:
         # wire order.
         self._state_lock = threading.Lock()
         self._send_lock = threading.Lock()
+        self._spawn()
+
+    def _spawn(self) -> None:
+        self._conn, child_conn = self._ctx.Pipe()
+        self._proc = self._ctx.Process(
+            target=_worker_main, args=(child_conn, self._env),
+            daemon=True, name=f"rla-tpu-worker-{self.rank}")
+        self._proc.start()
+        child_conn.close()
         self._pending: List[Future] = []
-        self._collector = threading.Thread(target=self._collect, daemon=True)
+        # the collector binds ITS generation's pipe/pending/process: after a
+        # restart() swaps them on self, the old thread must keep draining the
+        # old pipe (to fail the old futures), not the new one
+        self._collector = threading.Thread(
+            target=self._collect,
+            args=(self._conn, self._proc, self._pending), daemon=True)
         self._collector.start()
+
+    @property
+    def is_alive(self) -> bool:
+        return self._proc.is_alive()
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        return self._proc.exitcode
+
+    def restart(self) -> None:
+        """Respawn a dead (or wedged) worker process with the same rank/env.
+
+        The reference is fail-fast by explicit design (no_restart actors,
+        SURVEY.md §5.3 / reference: ray_ddp.py:119); this is the recovery
+        primitive it deliberately lacks.  Pending futures on the old process
+        fail with 'worker died'; the new process starts with a clean slate —
+        callers re-dispatch work (resuming from checkpoints, see
+        runtime/elastic.py)."""
+        with self._send_lock:
+            if self._proc.is_alive():
+                self._proc.terminate()
+            self._proc.join(timeout=10)
+            if self._proc.is_alive():
+                # SIGTERM blocked/ignored (wedged in uninterruptible work):
+                # escalate, or we'd leak a duplicate-rank process whose open
+                # pipe end keeps the old collector (and its futures) hanging
+                self._proc.kill()
+                self._proc.join(timeout=10)
+            self._conn.close()  # unblocks the old collector via EOF/OSError
+            self._spawn()
 
     # ------------------------------------------------------------------ #
     def execute(self, fn: Callable, *args, **kwargs) -> Future:
@@ -128,21 +167,22 @@ class Worker:
                     f"worker {self.rank} died before accepting work: {e}"))
         return fut
 
-    def _collect(self) -> None:
+    def _collect(self, conn, proc, pending_list) -> None:
         while True:
             try:
-                blob = self._conn.recv_bytes()
+                blob = conn.recv_bytes()
             except (EOFError, OSError):
                 with self._state_lock:
-                    pending, self._pending = self._pending, []
+                    pending = list(pending_list)
+                    pending_list.clear()
                 for fut in pending:
                     if not fut.done():
                         fut.set_exception(RuntimeError(
                             f"worker {self.rank} died "
-                            f"(exitcode={self._proc.exitcode})"))
+                            f"(exitcode={proc.exitcode})"))
                 return
             with self._state_lock:
-                fut = self._pending.pop(0)
+                fut = pending_list.pop(0)
             try:
                 status, payload = cloudpickle.loads(blob)
                 if status == "ok":
@@ -234,6 +274,46 @@ class ActorPool:
         for ip in self.node_ips():
             ranks.append(counts.get(ip, 0))
             counts[ip] = counts.get(ip, 0) + 1
+        return ranks
+
+    # ------------------------------------------------------------------ #
+    # failure detection / recovery (absent-by-design in the reference,
+    # SURVEY.md §5.3; first-class here)                                  #
+    # ------------------------------------------------------------------ #
+    def health_check(self) -> List[bool]:
+        """Liveness per rank, detected from the OS process state."""
+        return [w.is_alive for w in self.workers]
+
+    def restart_dead(self, init_hook: Optional[Callable[[], None]] = None) \
+            -> List[int]:
+        """Respawn every dead worker; returns the restarted ranks."""
+        restarted = []
+        for w in self.workers:
+            if not w.is_alive:
+                w.restart()
+                restarted.append(w.rank)
+        if restarted and init_hook is not None:
+            for rank in restarted:
+                self.workers[rank].execute(init_hook).result()
+        if restarted:
+            log.warning("restarted dead workers: %s", restarted)
+        return restarted
+
+    def restart_all(self, init_hook: Optional[Callable[[], None]] = None) \
+            -> List[int]:
+        """Respawn EVERY worker, alive or not.
+
+        The recovery primitive for collective (SPMD) work: when one rank
+        dies mid-collective its peers stay alive-but-wedged in the broken
+        collective, so restarting only the dead rank would re-dispatch into
+        workers that never dequeue again.  All ranks restart together."""
+        for w in self.workers:
+            w.restart()
+        ranks = [w.rank for w in self.workers]
+        if init_hook is not None:
+            for f in self.execute_all(init_hook):
+                f.result()
+        log.warning("restarted all workers: %s", ranks)
         return ranks
 
     def shutdown(self) -> None:

@@ -1,0 +1,91 @@
+"""Elastic execution: retry fan-out work across worker failures.
+
+The reference explicitly punts on fault tolerance — actors are created with
+no restart policy, a crash surfaces as a raised exception from the driver
+poll loop, and the README defers elasticity to RaySGD (SURVEY.md §5.3;
+reference: ray_lightning/ray_ddp.py:119, util.py:103, README.md:111).
+This module is the recovery layer that design left out, built on the two
+primitives the runtime provides:
+
+- failure *detection*: a dead worker fails its futures with 'worker died'
+  (runtime/actors.py collector) and shows dead in ``pool.health_check()``;
+- worker *restart*: ``pool.restart_dead()`` respawns crashed ranks with
+  their rank/env intact.
+
+Recovery is checkpoint-based, matching the framework's training semantics:
+a collective (SPMD) step cannot survive losing a participant mid-step, so
+on failure the runner restarts dead ranks and re-dispatches the whole
+attempt; the dispatched function is expected to resume from the latest
+checkpoint (see utils/checkpoint.latest_checkpoint and
+Trainer.fit(ckpt_path="last")).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..utils.logging import log
+from .actors import ActorPool
+from .queue import TrampolineQueue, process_results
+
+
+class ElasticRunner:
+    """Run per-worker callables with restart-and-resume on failure."""
+
+    def __init__(self, pool: ActorPool, max_failures: int = 3,
+                 backoff_s: float = 0.0,
+                 on_failure: Optional[Callable[[int, BaseException], None]]
+                 = None,
+                 init_hook: Optional[Callable[[], None]] = None):
+        """``max_failures``: attempts beyond the first before giving up.
+        ``on_failure(attempt, exc)``: observer hook per failed attempt.
+        ``init_hook``: re-run on restarted workers before re-dispatch
+        (parity with the accelerator's per-worker init_hook,
+        reference: ray_lightning/ray_ddp.py:106-107)."""
+        self.pool = pool
+        self.max_failures = max_failures
+        self.backoff_s = backoff_s
+        self.on_failure = on_failure
+        self.init_hook = init_hook
+        self.attempts_used = 0
+
+    def run(self, fn: Callable,
+            args_per_worker: Optional[Callable[[int], Sequence[tuple]]]
+            = None,
+            queue: Optional[TrampolineQueue] = None) -> List[Any]:
+        """Dispatch ``fn`` to every worker until one attempt fully succeeds.
+
+        ``args_per_worker(attempt)`` builds the per-rank argument tuples for
+        a given attempt — resume state (e.g. the latest checkpoint path)
+        belongs there.  ``fn`` must be re-runnable: each retry re-executes
+        the whole attempt on all ranks (collective steps cannot continue
+        with a hole in the mesh)."""
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.max_failures + 1):
+            self.attempts_used = attempt + 1
+            if attempt > 0:
+                if self.backoff_s:
+                    time.sleep(self.backoff_s * attempt)
+                # restart every rank, not just dead ones: survivors of a
+                # broken collective are alive-but-wedged and would never
+                # dequeue the retry
+                restarted = self.pool.restart_all(init_hook=self.init_hook)
+                log.warning("elastic attempt %d/%d (restarted ranks %s)",
+                            attempt + 1, self.max_failures + 1, restarted)
+            try:
+                if args_per_worker is not None:
+                    futures = self.pool.execute_per_worker(
+                        fn, args_per_worker(attempt))
+                else:
+                    futures = self.pool.execute_all(fn)
+                return process_results(futures, queue)
+            except BaseException as e:  # noqa: BLE001 — resurfaced below
+                last_exc = e
+                if self.on_failure is not None:
+                    self.on_failure(attempt, e)
+                if attempt == self.max_failures:
+                    break
+        raise RuntimeError(
+            f"elastic run failed after {self.max_failures + 1} attempts"
+        ) from last_exc

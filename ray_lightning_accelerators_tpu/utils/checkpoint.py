@@ -72,3 +72,21 @@ def restore_state(payload: Dict[str, Any], template_state):
 def restore_params(payload: Dict[str, Any], template_params):
     return flax.serialization.from_state_dict(template_params,
                                               payload["state"]["params"])
+
+
+def latest_checkpoint(directory: str, pattern: str = "*.ckpt") -> str | None:
+    """Newest checkpoint file under `directory` (recursive), or None.
+
+    The resume anchor for crash recovery (Trainer.fit(ckpt_path="last"),
+    runtime/elastic.py) — capability the reference lacks (SURVEY.md §5.4:
+    'No mid-run resume of a crashed job')."""
+    import glob
+
+    # escape the user directory: hyperparameter-stamped run dirs often carry
+    # glob metachars ('runs/sweep[lr=0.1]') that would silently match nothing
+    candidates = glob.glob(os.path.join(glob.escape(directory), "**", pattern),
+                           recursive=True)
+    candidates = [c for c in candidates if os.path.isfile(c)]
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)

@@ -24,6 +24,7 @@ The Trainer takes ``profiler=`` and wraps its hot phases
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -43,18 +44,26 @@ class _SpanHandle:
 
 
 class _SpanStat:
-    __slots__ = ("count", "total", "samples")
+    __slots__ = ("count", "total", "samples", "_rng")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
-        self.samples: List[float] = []  # capped reservoir for percentiles
+        self.samples: List[float] = []  # uniform reservoir for percentiles
+        self._rng = random.Random(0x5EED)
 
     def add(self, dt: float, cap: int = 4096) -> None:
         self.count += 1
         self.total += dt
+        # reservoir sampling: every span has equal probability of being in
+        # the percentile sample, so long runs aren't summarized by their
+        # first cap spans (compile/warmup) alone
         if len(self.samples) < cap:
             self.samples.append(dt)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < cap:
+                self.samples[j] = dt
 
 
 class Profiler:
@@ -180,9 +189,3 @@ def device_memory_stats() -> List[Dict[str, Any]]:
         except Exception:
             out.append({})
     return out
-
-
-class PassThroughProfiler(Profiler):
-    """No-op-ish default: spans still count, but with sync off and no
-    annotations overhead beyond TraceAnnotation's cheap enter/exit."""
-    pass

@@ -1,0 +1,217 @@
+"""Failure detection, worker restart, elastic retries, crash resume.
+
+The reference is fail-fast by explicit design (SURVEY.md §5.3: default
+restart policy, no_restart teardown, crash = raised exception at
+util.py:103; §5.4: 'No mid-run resume of a crashed job').  These tests pin
+the recovery layer this framework adds on top of those fail-fast semantics.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (ModelCheckpoint,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.runtime.actors import ActorPool, Worker
+from ray_lightning_accelerators_tpu.runtime.elastic import ElasticRunner
+from ray_lightning_accelerators_tpu.utils import checkpoint as ckpt_lib
+from tests.utils import BoringModel, boring_loaders
+
+
+def _crash(code=3):
+    os._exit(code)
+
+
+def _ok(x=1):
+    return x * 2
+
+
+def test_worker_crash_detected_and_future_fails():
+    w = Worker(0)
+    try:
+        fut = w.execute(_crash)
+        with pytest.raises(RuntimeError, match="died"):
+            fut.result(timeout=60)
+        w._proc.join(timeout=10)
+        assert not w.is_alive
+        assert w.exitcode == 3
+    finally:
+        w.kill()
+
+
+def test_worker_restart_after_crash():
+    w = Worker(0)
+    try:
+        with pytest.raises(RuntimeError):
+            w.execute(_crash).result(timeout=60)
+        w.restart()
+        assert w.is_alive
+        assert w.execute(_ok, 21).result(timeout=60) == 42
+    finally:
+        w.shutdown()
+
+
+def test_pool_health_check_and_restart_dead():
+    pool = ActorPool(2)
+    try:
+        assert pool.health_check() == [True, True]
+        with pytest.raises(RuntimeError):
+            pool.workers[1].execute(_crash).result(timeout=60)
+        pool.workers[1]._proc.join(timeout=10)
+        assert pool.health_check() == [True, False]
+        marker = {"ran": False}
+
+        restarted = pool.restart_dead()
+        assert restarted == [1]
+        assert pool.health_check() == [True, True]
+        assert pool.workers[1].execute(_ok).result(timeout=60) == 2
+    finally:
+        pool.shutdown()
+
+
+def _flaky(attempt, rank, blowup_attempts):
+    # crash rank 1 during early attempts; succeed afterwards
+    if rank == 1 and attempt < blowup_attempts:
+        os._exit(17)
+    return (attempt, rank)
+
+
+def test_elastic_runner_recovers_and_returns():
+    pool = ActorPool(2)
+    failures = []
+    try:
+        runner = ElasticRunner(pool, max_failures=3,
+                               on_failure=lambda a, e: failures.append(a))
+        out = runner.run(
+            _flaky,
+            args_per_worker=lambda attempt: [(attempt, r, 2)
+                                             for r in range(2)])
+        assert out == [(2, 0), (2, 1)]
+        assert runner.attempts_used == 3
+        assert failures == [0, 1]
+    finally:
+        pool.shutdown()
+
+
+def test_elastic_runner_gives_up():
+    pool = ActorPool(2)
+    try:
+        runner = ElasticRunner(pool, max_failures=1)
+        with pytest.raises(RuntimeError, match="after 2 attempts"):
+            runner.run(_flaky,
+                       args_per_worker=lambda a: [(a, r, 99)
+                                                  for r in range(2)])
+    finally:
+        pool.shutdown()
+
+
+def test_latest_checkpoint_picks_newest(tmp_path):
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) is None
+    a = tmp_path / "ckpts" / "epoch=0-step=8.ckpt"
+    b = tmp_path / "ckpts" / "epoch=1-step=16.ckpt"
+    a.parent.mkdir()
+    a.write_bytes(b"x")
+    b.write_bytes(b"y")
+    os.utime(a, (1, 1))
+    os.utime(b, (2, 2))
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == str(b)
+
+
+def test_trainer_resume_last_continues_training(tmp_path):
+    train, val = boring_loaders()
+    root = str(tmp_path / "run")
+    model = BoringModel()
+    t1 = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
+                 precision="f32", default_root_dir=root, seed=0,
+                 callbacks=[ModelCheckpoint(monitor=None, save_top_k=1)])
+    t1.fit(model, train, val)
+    steps_after_2 = t1.global_step
+    w_after_2 = np.asarray(model.params["layer"]["kernel"]).copy()
+
+    # simulated crash recovery: a fresh trainer + fresh module resume from
+    # the newest checkpoint and continue to epoch 4
+    model2 = BoringModel()
+    t2 = Trainer(max_epochs=4, accelerator=RayTPUAccelerator(),
+                 precision="f32", default_root_dir=root, seed=0,
+                 callbacks=[ModelCheckpoint(monitor=None, save_top_k=1)])
+    t2.fit(model2, train, val, ckpt_path="last")
+    assert t2.current_epoch == 4
+    assert t2.global_step == 2 * steps_after_2
+    # resumed run continued FROM the saved weights, not from re-init
+    assert not np.allclose(np.asarray(model2.params["layer"]["kernel"]),
+                           w_after_2)
+
+
+def _sleep_forever():
+    import time
+    time.sleep(10_000)
+
+
+def test_restart_all_recovers_wedged_survivors():
+    # rank 0 dies, rank 1 stays alive-but-wedged (the broken-collective
+    # failure mode); restart_all must bring BOTH back to a dequeuing state
+    pool = ActorPool(2)
+    try:
+        f0 = pool.workers[0].execute(_crash)
+        f1 = pool.workers[1].execute(_sleep_forever)
+        with pytest.raises(RuntimeError):
+            f0.result(timeout=60)
+        assert pool.workers[1].is_alive  # wedged, not dead
+        pool.restart_all()
+        assert pool.health_check() == [True, True]
+        outs = [f.result(timeout=60) for f in pool.execute_all(_ok, 5)]
+        assert outs == [10, 10]
+        with pytest.raises(RuntimeError):  # old wedged future was failed
+            f1.result(timeout=60)
+    finally:
+        pool.shutdown()
+
+
+def test_save_last_resume_epoch_accounting(tmp_path):
+    # save_last writes from on_fit_end (after the final epoch increment);
+    # the stored epoch must still equal COMPLETED epochs, not one more
+    train, val = boring_loaders()
+    root = str(tmp_path / "run")
+    t1 = Trainer(max_epochs=3, accelerator=RayTPUAccelerator(),
+                 precision="f32", default_root_dir=root, seed=0,
+                 callbacks=[ModelCheckpoint(monitor=None, save_last=True)])
+    t1.fit(BoringModel(), train, val)
+    last = t1.checkpoint_callback.last_model_path
+    assert last
+    assert ckpt_lib.read_checkpoint(last)["epoch"] == 3
+
+    t2 = Trainer(max_epochs=5, accelerator=RayTPUAccelerator(),
+                 precision="f32", default_root_dir=root, seed=0,
+                 enable_checkpointing=False)
+    t2.fit(BoringModel(), train, val, ckpt_path=last)
+    assert t2.current_epoch == 5
+    assert t2.global_step == 5 * len(train)
+
+
+def test_max_steps_truncated_epoch_not_counted(tmp_path):
+    train, val = boring_loaders()
+    t = Trainer(max_steps=len(train) + 2, accelerator=RayTPUAccelerator(),
+                precision="f32", default_root_dir=str(tmp_path), seed=0,
+                enable_checkpointing=False)
+    t.fit(BoringModel(), train, val)
+    assert t.epochs_completed == 1  # second epoch was cut short
+    assert ckpt_lib.read_checkpoint is not None
+
+
+def test_trainer_resume_last_empty_dir_starts_fresh(tmp_path):
+    train, val = boring_loaders()
+    t = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                precision="f32", enable_checkpointing=False,
+                default_root_dir=str(tmp_path / "empty"), seed=0)
+    t.fit(BoringModel(), train, val, ckpt_path="last")
+    assert t.current_epoch == 1
+
+
+def test_trainer_resume_missing_path_raises(tmp_path):
+    train, val = boring_loaders()
+    t = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                precision="f32", enable_checkpointing=False, seed=0)
+    with pytest.raises(FileNotFoundError):
+        t.fit(BoringModel(), train, val,
+              ckpt_path=str(tmp_path / "nope.ckpt"))
