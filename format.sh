@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Lint / format gate (capability analog of the reference's format.sh, which
+# ran yapf + flake8 over the diff vs mergebase; reference: format.sh +
+# .style.yapf).  Usage:
+#   ./format.sh          # check files changed vs origin/main (or HEAD~1)
+#   ./format.sh --all    # check the whole tree
+#
+# Uses flake8 when installed (CI installs it); falls back to a byte-compile
+# sweep so the script still gates syntax errors in minimal environments.
+
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if [[ "${1:-}" == "--all" ]]; then
+    FILES=$(git ls-files '*.py')
+else
+    BASE=$(git merge-base origin/main HEAD 2>/dev/null || git rev-parse HEAD~1)
+    FILES=$(git diff --name-only --diff-filter=ACMR "$BASE" -- '*.py')
+fi
+
+if [[ -z "$FILES" ]]; then
+    echo "format.sh: no python files to check"
+    exit 0
+fi
+
+if python -c 'import flake8' 2>/dev/null; then
+    # E501 relaxed to 88 to match the prevailing style; E731/W503 match the
+    # reference's flake8 tolerances for lambda-heavy framework code
+    echo "$FILES" | xargs python -m flake8 \
+        --max-line-length=88 --extend-ignore=E731,W503,E203
+    echo "format.sh: flake8 clean"
+else
+    echo "$FILES" | xargs python -m py_compile
+    echo "format.sh: flake8 not installed; byte-compile check passed"
+fi
