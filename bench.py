@@ -4,12 +4,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Metric matches BASELINE.json's north star (MNIST imgs/sec/chip; the reference
 publishes no numbers, BASELINE.md): images/sec/chip training the
-MNISTClassifier example end-to-end through Trainer + RayTPUAccelerator --
-including the input pipeline, sharded batch placement, and optimizer -- on
-the default backend (the real TPU chip under the driver; CPU fallback keeps
-the script runnable anywhere).
+MNISTClassifier example end-to-end through Trainer + RayTPUAccelerator on the
+default backend (the real TPU chip under the driver; CPU fallback keeps the
+script runnable anywhere).  The timed region is epochs 2..N of a single
+public-API ``fit`` — epoch 1 absorbs compile + the one-time device-cache
+shipment, the steady-state epochs measure the training loop the way a user
+runs it (device-resident gather feeding a donated, jitted train step).
 
-Baseline constant: 25_000 imgs/sec -- a single-A100 PTL+DDP run of this
+Baseline constant: 25_000 imgs/sec — a single-A100 PTL+DDP run of this
 3-layer-MLP example is input-pipeline-bound in that regime (BASELINE.json
 target: ">= single-A100 DDP throughput").
 """
@@ -25,11 +27,28 @@ BASELINE_IMGS_PER_SEC = 25_000.0
 def main() -> None:
     import jax
 
-    from ray_lightning_accelerators_tpu import (RayTPUAccelerator, Trainer,
-                                                DataLoader)
+    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
+                                                RayTPUAccelerator, Trainer)
     from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
     from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
                                                              synthetic_mnist)
+
+    class EpochClock(Callback):
+        """Wall time at each train-epoch boundary (device-synced)."""
+
+        def __init__(self):
+            self.marks = []
+
+        def _mark(self, trainer):
+            if trainer._state is not None:
+                jax.block_until_ready(trainer._state.params)
+            self.marks.append(time.perf_counter())
+
+        def on_train_epoch_start(self, trainer, module):
+            self._mark(trainer)
+
+        def on_fit_end(self, trainer, module):
+            self._mark(trainer)
 
     n_devices = jax.device_count()
     batch_size = 1024 * n_devices
@@ -40,28 +59,19 @@ def main() -> None:
 
     model = MNISTClassifier({"layer_1": 128, "layer_2": 256, "lr": 1e-3,
                              "batch_size": batch_size})
-    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+    clock = EpochClock()
+    epochs = 5
+    trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
                       precision="bf16", enable_checkpointing=False,
-                      log_every_n_steps=10 ** 9, seed=0,
+                      log_every_n_steps=10 ** 9, seed=0, callbacks=[clock],
                       default_root_dir="/tmp/rla_tpu_bench")
-    # warmup epoch: compile + cache
     trainer.fit(model, loader)
 
-    # timed epochs through the same fitted trainer state
+    # steady state: epochs 2..N (epoch 1 paid compile + cache shipment)
     steps_per_epoch = len(loader)
-    epochs = 4
-    t0 = time.perf_counter()
-    state = trainer._state
-    for _ in range(epochs):
-        for batch in loader:
-            state, metrics = trainer._train_step_fn(
-                state, trainer._put_batch(batch))
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-
-    imgs = batch_size * steps_per_epoch * epochs
-    imgs_per_sec = imgs / dt
-    per_chip = imgs_per_sec / n_devices
+    dt = clock.marks[-1] - clock.marks[1]
+    imgs = batch_size * steps_per_epoch * (epochs - 1)
+    per_chip = imgs / dt / n_devices
     print(json.dumps({
         "metric": "mnist_mlp_train_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),

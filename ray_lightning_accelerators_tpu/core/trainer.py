@@ -72,6 +72,7 @@ class Trainer:
                  num_sanity_val_steps: int = 0,
                  enable_progress_bar: bool = False,
                  profiler: Optional["Profiler"] = None,
+                 cache_dataset_on_device: Any = "auto",
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -98,6 +99,9 @@ class Trainer:
         self.num_sanity_val_steps = num_sanity_val_steps
         self.enable_progress_bar = enable_progress_bar
         self.profiler = profiler
+        # device-resident dataset cache: "auto" caches array-backed datasets
+        # up to _CACHE_MAX_BYTES; True forces (when eligible), False disables
+        self.cache_dataset_on_device = cache_dataset_on_device
         self.seed = seed_everything(seed)
 
         if enable_checkpointing and not any(
@@ -119,6 +123,8 @@ class Trainer:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._val_loader = None
+        self._device_cache = None
+        self._train_step_cached_fn = None
 
     # ------------------------------------------------------------------ #
     # Checkpoint plumbing                                                #
@@ -228,6 +234,8 @@ class Trainer:
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, repl),
             donate_argnums=0)
+        if self._device_cache is not None:
+            self._compile_cached_step(train_step, state_sh, batch_sh, repl)
         self._eval_step_fn = jax.jit(
             eval_step, in_shardings=(state_sh.params, batch_sh))
         self._test_step_fn = jax.jit(
@@ -235,6 +243,82 @@ class Trainer:
         self._predict_step_fn = jax.jit(predict_step)
         self._batch_sharding = batch_sh
         self._state_shardings = state_sh
+
+    # ------------------------------------------------------------------ #
+    # Device-resident dataset cache                                      #
+    # ------------------------------------------------------------------ #
+    _CACHE_MAX_BYTES = 1 << 30  # "auto" ships datasets up to 1 GiB to HBM
+    # "auto" engages only where per-batch h2d is expensive (TPU/GPU links);
+    # on the CPU backend the replicated cache copies cost more than they save
+    _CACHE_AUTO_ON_CPU = False
+
+    def _build_device_cache(self, loader) -> bool:
+        """Ship an array-backed dataset to HBM once; per-step input becomes a
+        tiny int32 index row gathered ON device.
+
+        The TPU-idiomatic answer to SURVEY.md §7.4 hard part 4 (input
+        pipeline dominates small models): per-batch host->device transfer is
+        the bottleneck — over a tunneled/remote PjRt link catastrophically so
+        — and a dataset that fits HBM never needs to cross the link twice."""
+        self._device_cache = None
+        mode = self.cache_dataset_on_device
+        if mode is False or not isinstance(loader, DataLoader):
+            return False
+        arrays = getattr(loader.dataset, "_native_arrays", lambda: None)()
+        if not arrays or any(a.dtype.hasobject for a in arrays):
+            return False
+        from ..data.loader import default_collate
+        if loader.collate_fn is not default_collate:
+            return False
+        if jax.process_count() > 1:
+            return False  # multi-host feeds per-process shards
+        total = sum(a.nbytes for a in arrays)
+        if mode == "auto":
+            if total > self._CACHE_MAX_BYTES:
+                return False
+            if (jax.default_backend() == "cpu"
+                    and not self._CACHE_AUTO_ON_CPU):
+                return False
+        repl = jax.sharding.NamedSharding(self._mesh,
+                                          jax.sharding.PartitionSpec())
+        self._device_cache = tuple(
+            jax.device_put(np.ascontiguousarray(a), repl) for a in arrays)
+        self._cache_single = len(arrays) == 1
+        return True
+
+    def _compile_cached_step(self, train_step, state_sh, batch_sh, repl):
+        def gather(cache, idx):
+            batch = tuple(jnp.take(a, idx, axis=0) for a in cache)
+            batch = batch[0] if self._cache_single else batch
+            return jax.lax.with_sharding_constraint(
+                batch, jax.tree.map(lambda _: batch_sh, batch))
+
+        def cached_step(st, cache, idx):
+            return train_step(st, gather(cache, idx))
+
+        self._train_step_cached_fn = jax.jit(
+            cached_step,
+            in_shardings=(state_sh, repl, repl),
+            out_shardings=(state_sh, repl),
+            donate_argnums=0)
+
+    def _cached_epoch_source(self, loader):
+        """Yield per-step device index rows (plus a host-path trailing
+        partial batch when drop_last=False), honoring the loader's sampler
+        order exactly."""
+        perm = np.fromiter(loader.sampler, np.int64)
+        bs = loader.batch_size
+        nb = len(perm) // bs
+        if nb:
+            idx_mat = jax.device_put(
+                perm[:nb * bs].astype(np.int32).reshape(nb, bs))
+            for i in range(nb):
+                yield ("cached", idx_mat[i])
+        tail = perm[nb * bs:]
+        if len(tail) and not loader.drop_last:
+            arrays = loader.dataset._native_arrays()
+            batch = tuple(a[tail] for a in arrays)
+            yield ("host", batch[0] if len(batch) == 1 else batch)
 
     def _put_batch(self, batch):
         """Ship one host batch to the mesh with the batch sharding.
@@ -314,6 +398,7 @@ class Trainer:
 
         example_batch = next(iter(train_loader))
         self._check_batch(example_batch)
+        self._build_device_cache(train_loader)
         self._compile(module, state, example_batch)
 
         # place state on mesh with its shardings
@@ -338,17 +423,29 @@ class Trainer:
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(self.current_epoch)
 
-            for batch_idx, batch in enumerate(
-                    self._iter_profiled(train_loader)):
+            if self._device_cache is not None:
+                source = self._cached_epoch_source(train_loader)
+            else:
+                source = (("host", b)
+                          for b in self._iter_profiled(train_loader))
+            for batch_idx, (kind, payload) in enumerate(source):
                 if (self.limit_train_batches is not None
                         and batch_idx >= self.limit_train_batches):
                     break
-                with self._span("h2d"):
-                    batch = self._put_batch(batch)
-                with self._span("train_step") as h:
-                    state, train_metrics = self._train_step_fn(state, batch)
-                    if h is not None:
-                        h.set(train_metrics)
+                if kind == "cached":
+                    with self._span("train_step") as h:
+                        state, train_metrics = self._train_step_cached_fn(
+                            state, self._device_cache, payload)
+                        if h is not None:
+                            h.set(train_metrics)
+                else:
+                    with self._span("h2d"):
+                        batch = self._put_batch(payload)
+                    with self._span("train_step") as h:
+                        state, train_metrics = self._train_step_fn(state,
+                                                                   batch)
+                        if h is not None:
+                            h.set(train_metrics)
                 self.global_step += 1
                 self._state = state
                 for c in self.callbacks:
@@ -562,4 +659,6 @@ class Trainer:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._state = None
+        self._device_cache = None
+        self._train_step_cached_fn = None
         self.accelerator.teardown()

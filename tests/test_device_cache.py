@@ -1,0 +1,121 @@
+"""Device-resident dataset cache: training parity with the host-fed path,
+eligibility gating, partial batches (the TPU-idiomatic input pipeline for
+datasets that fit HBM — SURVEY.md §7.4 hard part 4)."""
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (DataLoader, RayTPUAccelerator,
+                                            Trainer)
+from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+from tests.utils import BoringModel, boring_loaders
+
+
+def _fit(cache, max_epochs=2, drop_last=True, use_fsdp=False):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((70, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=True,
+                       drop_last=drop_last)
+    model = BoringModel()
+    trainer = Trainer(max_epochs=max_epochs,
+                      accelerator=RayTPUAccelerator(use_fsdp=use_fsdp),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=cache,
+                      log_every_n_steps=10 ** 9)
+    trainer.fit(model, train)
+    return trainer, model
+
+
+def test_cached_matches_host_fed_training():
+    t_host, m_host = _fit(cache=False)
+    t_cached, m_cached = _fit(cache=True)
+    assert t_cached._device_cache is not None
+    assert t_cached.global_step == t_host.global_step
+    np.testing.assert_allclose(
+        np.asarray(m_cached.params["layer"]["kernel"]),
+        np.asarray(m_host.params["layer"]["kernel"]), rtol=1e-5, atol=1e-6)
+
+
+def test_cached_matches_host_fed_with_fsdp_mesh():
+    t_host, m_host = _fit(cache=False, use_fsdp=True)
+    t_cached, m_cached = _fit(cache=True, use_fsdp=True)
+    assert t_cached._device_cache is not None
+    np.testing.assert_allclose(
+        np.asarray(m_cached.params["layer"]["kernel"]),
+        np.asarray(m_host.params["layer"]["kernel"]), rtol=1e-5, atol=1e-6)
+
+
+def test_partial_trailing_batch_uses_host_path():
+    # 70 rows / batch 8 -> 8 full cached steps + 1 host-fed partial of 6...
+    # but the partial (6 rows) must still divide the 8-way dp axis, so use
+    # a 64+8k split instead: 72 rows -> 9 full batches exactly; then 80 rows
+    # with drop_last=False -> 10 full, still exact. Use batch 16 over 72:
+    # 4 full + partial 8 (divisible by dp=8).
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((72, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=16, shuffle=False,
+                       drop_last=False)
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True)
+    trainer.fit(BoringModel(), train)
+    assert trainer.global_step == 5  # 4 cached + 1 host partial
+
+
+def test_auto_respects_size_threshold(monkeypatch):
+    monkeypatch.setattr(Trainer, "_CACHE_AUTO_ON_CPU", True)
+    monkeypatch.setattr(Trainer, "_CACHE_MAX_BYTES", 64)
+    t_auto, _ = _fit(cache="auto")
+    assert t_auto._device_cache is None  # dataset over the auto cap
+    monkeypatch.setattr(Trainer, "_CACHE_MAX_BYTES", 1 << 30)
+    t_auto2, _ = _fit(cache="auto")
+    assert t_auto2._device_cache is not None  # under the cap: cached
+    t_forced, _ = _fit(cache=True)
+    assert t_forced._device_cache is not None  # explicit True overrides
+
+
+def test_auto_disabled_on_cpu_backend():
+    t_auto, _ = _fit(cache="auto")
+    assert t_auto._device_cache is None  # CPU backend: replication loses
+
+
+def test_ineligible_datasets_fall_back():
+    train, val = boring_loaders()  # RandomDataset exposes arrays -> eligible
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True)
+    trainer.fit(BoringModel(), train, val)
+    assert trainer._device_cache is not None
+
+    class NoArrays:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return np.zeros(32, np.float32)
+
+    loader = DataLoader(NoArrays(), batch_size=8)
+    t2 = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                 precision="f32", enable_checkpointing=False, seed=0,
+                 cache_dataset_on_device=True)
+    t2.fit(BoringModel(), loader)
+    assert t2._device_cache is None
+    assert t2.global_step == 8
+
+
+def test_epoch_reshuffle_respected_when_cached():
+    # deterministic parity across both paths over multiple shuffled epochs
+    # is already asserted above; here make sure two epochs don't reuse one
+    # index order (sampler.set_epoch flows through _cached_epoch_source)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=True)
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True)
+    trainer.fit(BoringModel(), train)
+    train.set_epoch(0)
+    first = np.fromiter(train.sampler, np.int64)
+    train.set_epoch(1)
+    second = np.fromiter(train.sampler, np.int64)
+    assert not np.array_equal(first, second)

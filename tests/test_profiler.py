@@ -54,17 +54,33 @@ def test_sync_span_blocks_on_device_outputs():
 
 
 def test_trainer_profiler_integration():
+    # host-fed path (cache off): fetch/h2d/step spans per batch
     prof = Profiler()
     train, val = boring_loaders()
     trainer = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
                       precision="f32", enable_checkpointing=False,
-                      profiler=prof, log_every_n_steps=10 ** 9, seed=0)
+                      profiler=prof, log_every_n_steps=10 ** 9, seed=0,
+                      cache_dataset_on_device=False)
     trainer.fit(BoringModel(), train, val)
     s = prof.summary()
     assert s["train_step"]["count"] == trainer.global_step > 0
     assert s["data_fetch"]["count"] >= trainer.global_step
     assert s["h2d"]["count"] == trainer.global_step
     assert s["validation"]["count"] == 2
+
+
+def test_trainer_profiler_integration_cached_path():
+    # device-cached path: train_step spans only (no per-batch h2d)
+    prof = Profiler()
+    train, val = boring_loaders()
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False,
+                      profiler=prof, log_every_n_steps=10 ** 9, seed=0,
+                      cache_dataset_on_device=True)
+    trainer.fit(BoringModel(), train, val)
+    s = prof.summary()
+    assert s["train_step"]["count"] == trainer.global_step > 0
+    assert "h2d" not in s
 
 
 def test_device_trace_roundtrip(tmp_path):
