@@ -102,6 +102,10 @@ class TuneReportCallback(TuneCallback):
         if report:
             # thunk through the session queue (reference: tune.py:101)
             session_lib.put_queue(lambda: run_lib.report(**report))
+        # cooperative scheduler stop: a STOP decision from a prior report
+        # ends training cleanly at this boundary
+        if run_lib.trial_should_stop():
+            trainer.should_stop = True
 
 
 class _TuneCheckpointCallback(TuneCallback):

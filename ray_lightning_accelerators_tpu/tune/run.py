@@ -40,6 +40,7 @@ class Trial:
         self.checkpoints: List[Tuple[int, str]] = []  # (step, path)
         self.status = "PENDING"
         self.error: Optional[BaseException] = None
+        self.should_stop = False  # set by a scheduler's STOP decision
 
     @property
     def last_result(self) -> Dict[str, Any]:
@@ -72,13 +73,19 @@ class _TrialSession:
     of a Ray Tune session; probed via is_session_enabled,
     reference: ray_lightning/tune.py:10-22)."""
 
-    def __init__(self, trial: Trial):
+    def __init__(self, trial: Trial, scheduler=None):
         self.trial = trial
+        self.scheduler = scheduler
         self._lock = threading.Lock()
 
     def report(self, **metrics) -> None:
         with self._lock:
             self.trial.report(metrics)
+            if self.scheduler is not None and not self.trial.should_stop:
+                decision = self.scheduler.on_result(self.trial,
+                                                    self.trial.last_result)
+                if decision == self.scheduler.STOP:
+                    self.trial.should_stop = True
 
 
 _trial_session: Optional[_TrialSession] = None
@@ -93,6 +100,12 @@ def get_trial_session() -> _TrialSession:
         raise RuntimeError("tune.report()/checkpointing used outside a "
                            "tune.run() trial")
     return _trial_session
+
+
+def trial_should_stop() -> bool:
+    """True when the active trial was STOPped by a scheduler; the Tune
+    callbacks poll this and end training cleanly via trainer.should_stop."""
+    return _trial_session is not None and _trial_session.trial.should_stop
 
 
 def report(**metrics) -> None:
@@ -176,17 +189,24 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         seed: int = 0,
         raise_on_failed_trial: bool = True,
         verbose: int = 0,
+        scheduler=None,
         **_compat_kwargs) -> ExperimentAnalysis:
     """Run `trainable(config)` for every sampled/grid config.
 
     `resources_per_trial` is accepted for signature parity (the reference's
     extra_cpu bookkeeping, examples/ray_ddp_example.py:107-112) -- placement
-    is meaningful only under the multi-host actor runtime.
+    is meaningful only under the multi-host actor runtime.  `scheduler` is a
+    tune.schedulers.TrialScheduler (e.g. ASHAScheduler) consulted on every
+    reported result; its STOP decisions end trials early and mark them
+    STOPPED.
     """
     name = name or f"tune_{int(time.time())}"
     local_dir = local_dir or os.path.join(os.getcwd(), "rla_tpu_results")
     exp_dir = os.path.join(local_dir, name)
     os.makedirs(exp_dir, exist_ok=True)
+
+    if scheduler is not None:
+        scheduler.set_search_properties(metric, mode)
 
     configs = generate_trial_configs(config, num_samples, seed)
     trials = []
@@ -195,14 +215,14 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         trial = Trial(f"trial_{i:05d}", cfg, exp_dir)
         trials.append(trial)
         q = TrampolineQueue()
-        _trial_session = _TrialSession(trial)
+        _trial_session = _TrialSession(trial, scheduler)
         session_lib.init_session(rank=0, queue=q)
         trial.status = "RUNNING"
         try:
             with ThreadPoolExecutor(max_workers=1) as pool:
                 fut = pool.submit(trainable, cfg)
                 process_results([fut], q)
-            trial.status = "TERMINATED"
+            trial.status = "STOPPED" if trial.should_stop else "TERMINATED"
         except BaseException as e:  # noqa: BLE001 - fail-fast like ray.get
             trial.status = "ERROR"
             trial.error = e

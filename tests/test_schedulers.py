@@ -1,0 +1,111 @@
+"""Trial schedulers: ASHA rung decisions, median stopping, cooperative
+trainer stop through the Tune callbacks."""
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (RayTPUAccelerator, Trainer,
+                                            tune)
+from ray_lightning_accelerators_tpu.tune import (ASHAScheduler,
+                                                 MedianStoppingRule,
+                                                 TuneReportCallback)
+from tests.utils import BoringModel, boring_loaders
+
+
+class _T:
+    """Minimal trial stand-in for unit-level scheduler calls."""
+    trial_id = "t"
+
+
+def _res(it, loss):
+    return {"training_iteration": it, "loss": loss}
+
+
+def test_asha_rungs_and_cutoffs():
+    s = ASHAScheduler(metric="loss", mode="min", max_t=16, grace_period=1,
+                      reduction_factor=4)
+    assert s.rungs == [1, 4]
+    # first rf-1 results at a rung continue optimistically
+    assert s.on_result(_T(), _res(1, 5.0)) == s.CONTINUE
+    assert s.on_result(_T(), _res(1, 1.0)) == s.CONTINUE
+    assert s.on_result(_T(), _res(1, 4.0)) == s.CONTINUE
+    # 4th result: cutoff = best 1/4 of [5,1,4,x]
+    assert s.on_result(_T(), _res(1, 0.5)) == s.CONTINUE  # new best
+    assert s.on_result(_T(), _res(1, 9.0)) == s.STOP      # clearly worst
+    # non-rung iterations never stop
+    assert s.on_result(_T(), _res(2, 99.0)) == s.CONTINUE
+    # max_t always stops
+    assert s.on_result(_T(), _res(16, 0.0)) == s.STOP
+
+
+def test_asha_max_mode():
+    s = ASHAScheduler(metric="acc", mode="max", max_t=8, grace_period=1,
+                      reduction_factor=2)
+    for v in (0.1, 0.9):
+        s.on_result(_T(), {"training_iteration": 1, "acc": v})
+    assert s.on_result(
+        _T(), {"training_iteration": 1, "acc": 0.95}) == s.CONTINUE
+    assert s.on_result(
+        _T(), {"training_iteration": 1, "acc": 0.05}) == s.STOP
+
+
+def test_median_stopping_rule():
+    s = MedianStoppingRule(metric="loss", mode="min", grace_period=1)
+    for v in (1.0, 2.0, 3.0):
+        s.on_result(_T(), _res(2, v))
+    assert s.on_result(_T(), _res(2, 10.0)) == s.STOP
+    assert s.on_result(_T(), _res(2, 0.1)) == s.CONTINUE
+
+
+def test_tune_run_with_asha_stops_bad_trials():
+    # trainable reports a loss equal to its config value every iteration for
+    # 6 iterations; with grid [0.1, 5.0, 6.0, 7.0] and rungs at 1,2,4 the
+    # bad configs stop early while the best runs to completion
+    def trainable(config):
+        for _ in range(6):
+            tune.report(loss=config["lr"])
+            if tune.trial_should_stop():
+                return
+
+    analysis = tune.run(
+        trainable,
+        config={"lr": tune.grid_search([0.1, 5.0, 6.0, 7.0])},
+        metric="loss", mode="min",
+        scheduler=ASHAScheduler(max_t=6, grace_period=1,
+                                reduction_factor=2),
+        local_dir="/tmp/rla_tune_sched", name="asha_unit")
+    iters = {t.config["lr"]: t.training_iteration for t in analysis.trials}
+    assert analysis.best_config["lr"] == 0.1
+    assert iters[0.1] == 6                      # survivor runs out max_t
+    assert iters[6.0] < 6 and iters[7.0] < 6    # losers stopped early
+    assert all(t.status in ("STOPPED", "TERMINATED")
+               for t in analysis.trials)
+    # the early-stopped losers are distinguishable from full runs
+    assert analysis.trials[2].status == "STOPPED"
+
+
+def test_scheduler_stops_trainer_via_callback():
+    # end-to-end: Trainer + TuneReportCallback under tune.run with a
+    # scheduler that stops everything after the first report
+    class StopAll(tune.TrialScheduler):
+        metric = "val_loss"
+
+        def on_result(self, trial, result):
+            return self.STOP
+
+    def trainable(config):
+        train, val = boring_loaders()
+        trainer = Trainer(max_epochs=50, accelerator=RayTPUAccelerator(),
+                          precision="f32", enable_checkpointing=False,
+                          callbacks=[TuneReportCallback(["val_loss"])],
+                          seed=0)
+        trainer.fit(BoringModel(), train, val)
+        return trainer.current_epoch
+
+    analysis = tune.run(trainable, config={"x": 1}, metric="val_loss",
+                        mode="min", scheduler=StopAll(),
+                        local_dir="/tmp/rla_tune_sched", name="stopall")
+    t = analysis.trials[0]
+    assert t.status == "STOPPED"
+    # trainer ended long before max_epochs=50
+    assert t.training_iteration <= 3
