@@ -152,9 +152,8 @@ class GPT(TpuModule):
         return x
 
     def _rms_norm(self, x, scale):
-        x32 = x.astype(jnp.float32)
-        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-        return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+        # fused pallas kernel on TPU, jnp reference elsewhere (ops/norms.py)
+        return rms_norm(x, scale)
 
     def _attention(self, q, k, v):
         if self.mesh is not None and mesh_lib.mesh_axis_size(
