@@ -29,6 +29,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from ..parallel.ring_attention import ring_attention_sharded
 from ..ops.attention import flash_attention
+from ..ops.norms import rms_norm
 
 
 @dataclasses.dataclass
