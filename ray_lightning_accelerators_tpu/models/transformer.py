@@ -29,6 +29,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from ..parallel.ring_attention import ring_attention_sharded
 from ..ops.attention import flash_attention
+from ..ops.moe import init_moe_params, moe_logical_axes, moe_mlp
 from ..ops.norms import rms_norm
 
 
@@ -46,6 +47,12 @@ class TransformerConfig:
     pipeline_microbatches: int = 4  # GPipe schedule when mesh has pipeline>1
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
+    # MoE: num_experts > 1 replaces every dense MLP with a routed
+    # mixture-of-experts block sharded over the `expert` mesh axis
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -92,6 +99,13 @@ class GPT(TpuModule):
 
         def layer(key):
             ks = jax.random.split(key, 6)
+            if cfg.num_experts > 1:
+                mlp = init_moe_params(ks[4], d, f, cfg.num_experts)
+            else:
+                mlp = {
+                    "wi": dense(ks[4], (d, f), d),
+                    "wo": dense(ks[5], (f, d), f),
+                }
             return {
                 "attn": {
                     "wq": dense(ks[0], (d, h, hd), d),
@@ -99,10 +113,7 @@ class GPT(TpuModule):
                     "wv": dense(ks[2], (d, h, hd), d),
                     "wo": dense(ks[3], (h, hd, d), d),
                 },
-                "mlp": {
-                    "wi": dense(ks[4], (d, f), d),
-                    "wo": dense(ks[5], (f, d), f),
-                },
+                "mlp": mlp,
                 "ln1": jnp.ones((d,), jnp.float32),
                 "ln2": jnp.ones((d,), jnp.float32),
             }
@@ -121,6 +132,14 @@ class GPT(TpuModule):
     def param_logical_axes(self) -> Dict[str, Any]:
         """Logical axis names per leaf; consumed by the accelerator to build
         mesh shardings (parallel/sharding.py rules)."""
+        if self.cfg.num_experts > 1:
+            mlp_axes = {name: ("layers",) + ax
+                        for name, ax in moe_logical_axes().items()}
+        else:
+            mlp_axes = {
+                "wi": ("layers", "embed", "mlp"),
+                "wo": ("layers", "mlp", "embed"),
+            }
         axes = {
             "embed": ("vocab", "embed"),
             "layers": {
@@ -130,10 +149,7 @@ class GPT(TpuModule):
                     "wv": ("layers", "embed", "heads", "kv"),
                     "wo": ("layers", "heads", "kv", "embed"),
                 },
-                "mlp": {
-                    "wi": ("layers", "embed", "mlp"),
-                    "wo": ("layers", "mlp", "embed"),
-                },
+                "mlp": mlp_axes,
                 "ln1": ("layers", None),
                 "ln2": ("layers", None),
             },
@@ -184,14 +200,22 @@ class GPT(TpuModule):
 
         x = self._rms_norm(h, layer_params["ln2"])
         m = layer_params["mlp"]
-        up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"].astype(dt)))
-        up = self._constrain(up, mesh_lib.BATCH_AXES, mesh_lib.SEQUENCE_AXIS,
-                             mesh_lib.TENSOR_AXIS)
-        h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
+        if cfg.num_experts > 1:
+            y, aux = moe_mlp(x, m, top_k=cfg.moe_top_k,
+                             capacity_factor=cfg.moe_capacity_factor,
+                             compute_dtype=dt, mesh=self.mesh)
+            h = h + y
+        else:
+            aux = jnp.zeros((), jnp.float32)
+            up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"].astype(dt)))
+            up = self._constrain(up, mesh_lib.BATCH_AXES,
+                                 mesh_lib.SEQUENCE_AXIS,
+                                 mesh_lib.TENSOR_AXIS)
+            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
         return self._constrain(h, mesh_lib.BATCH_AXES,
-                               mesh_lib.SEQUENCE_AXIS, None)
+                               mesh_lib.SEQUENCE_AXIS, None), aux
 
-    def forward(self, params, batch):
+    def forward(self, params, batch, return_aux: bool = False):
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
@@ -206,26 +230,32 @@ class GPT(TpuModule):
             pos = jnp.arange(h_in.shape[1])
 
             def block(carry, layer_params):
-                return self._block(carry, layer_params, pos), None
+                return self._block(carry, layer_params, pos)
 
             if self.cfg.remat:
                 block = jax.checkpoint(block)
-            out, _ = jax.lax.scan(block, h_in, layers)
-            return out
+            out, aux_per_layer = jax.lax.scan(block, h_in, layers)
+            return out, jnp.sum(aux_per_layer)
 
         if self.mesh is not None and mesh_lib.mesh_axis_size(
                 self.mesh, mesh_lib.PIPELINE_AXIS) > 1:
+            if self.cfg.num_experts > 1:
+                raise NotImplementedError(
+                    "MoE layers under pipeline parallelism are not supported "
+                    "yet; use expert/tensor/data axes (set pipeline=1)")
             from ..parallel.pipeline import pipeline_apply
-            h = pipeline_apply(lambda lp, hm: stack(hm, lp),
+            h = pipeline_apply(lambda lp, hm: stack(hm, lp)[0],
                                params["layers"], h, self.mesh,
                                self.cfg.pipeline_microbatches)
+            aux = jnp.zeros((), jnp.float32)
         else:
-            h = stack(h, params["layers"])
+            h, aux = stack(h, params["layers"])
         h = self._rms_norm(h, params["ln_f"])
         unembed = (params["embed"].T if self.cfg.tie_embeddings
                    else params["unembed"])
         logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dt))
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return (logits, aux) if return_aux else logits
 
     # ------------------------------------------------------------------ #
     # Steps                                                              #
@@ -234,19 +264,23 @@ class GPT(TpuModule):
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
-        logits = self.forward(params, tokens)
+        logits, aux = self.forward(params, tokens, return_aux=True)
         targets = tokens[:, 1:]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], targets).mean()
         acc = jnp.mean(jnp.argmax(logits[:, :-1], -1) == targets)
-        return loss, acc
+        return loss, acc, aux
 
     def training_step(self, params, batch, rng):
-        loss, acc = self._lm_loss(params, batch)
-        return loss, {"loss": loss, "accuracy": acc}
+        loss, acc, aux = self._lm_loss(params, batch)
+        metrics = {"loss": loss, "accuracy": acc}
+        if self.cfg.num_experts > 1:
+            metrics["moe_aux_loss"] = aux
+            loss = loss + self.cfg.moe_aux_weight * aux
+        return loss, metrics
 
     def validation_step(self, params, batch):
-        loss, acc = self._lm_loss(params, batch)
+        loss, acc, _ = self._lm_loss(params, batch)
         return {"val_loss": loss, "val_accuracy": acc,
                 "val_perplexity": jnp.exp(loss)}
 

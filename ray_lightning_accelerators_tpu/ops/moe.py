@@ -1,0 +1,160 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+No reference analog (the reference implements only data parallelism,
+SURVEY.md §2.4); this exists because the TPU framework treats expert
+parallelism (the ``expert`` mesh axis, parallel/mesh.py:39) as first-class.
+
+Design is GShard/Switch-style and deliberately XLA-shaped:
+
+- routing, dispatch and combine are **static-shape einsums** over a
+  ``[batch, seq, experts, capacity]`` dispatch tensor — no gather/scatter
+  with data-dependent shapes, so the whole layer tiles onto the MXU and
+  jit-compiles once;
+- expert weights carry a leading ``experts`` dim annotated with the
+  ``expert`` logical axis; when the mesh has ``expert > 1`` XLA partitions
+  the expert einsums and inserts the all-to-alls itself;
+- tokens over capacity are *dropped* (their combine weight is zero) and
+  ride the residual connection — the standard Switch behavior;
+- the load-balancing auxiliary loss (Switch eq. 4) is returned alongside
+  the output so the caller can add ``aux_weight * aux`` to the task loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel import mesh as mesh_lib
+from ..parallel import sharding as sharding_lib
+
+
+def expert_capacity(seq_len: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token budget; static (derived from trace-time shapes)."""
+    cap = int(math.ceil(seq_len * top_k * capacity_factor / num_experts))
+    return max(cap, 1)
+
+
+def top_k_routing(router_logits: jax.Array, top_k: int, capacity: int
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute dispatch/combine tensors from router logits.
+
+    Args:
+      router_logits: ``[b, s, e]`` float32 logits.
+      top_k: experts per token.
+      capacity: per-expert slot count ``c``.
+
+    Returns:
+      ``dispatch`` ``[b, s, e, c]`` 0/1 — token (b,s) occupies slot c of
+      expert e; ``combine`` ``[b, s, e, c]`` — dispatch weighted by the
+      renormalized gate probability; ``aux`` scalar load-balance loss.
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    b, s, e = probs.shape
+    if top_k > e:
+        raise ValueError(f"moe top_k={top_k} exceeds num_experts={e}; a "
+                         "token cannot route to more experts than exist")
+
+    masks = []      # one-hot chosen expert per routing round
+    gates = []      # chosen-expert probability per round
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        m = jax.nn.one_hot(idx, e, dtype=probs.dtype)          # [b, s, e]
+        masks.append(m)
+        gates.append(jnp.sum(probs * m, axis=-1))              # [b, s]
+        remaining = remaining * (1.0 - m)
+
+    # Switch aux loss uses the first-choice assignment fractions.
+    frac_tokens = jnp.mean(masks[0], axis=(0, 1))              # [e]
+    frac_probs = jnp.mean(probs, axis=(0, 1))                  # [e]
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    # top_k > 1: renormalize so combine weights sum to 1 per token.
+    # top_k == 1 keeps the raw gate probability (Switch Transformer): a
+    # renormalized single gate is constant ~1 and would starve the router
+    # of task-loss gradient.
+    if top_k > 1:
+        gate_sum = sum(gates) + 1e-9
+        gates = [g / gate_sum for g in gates]
+
+    # Assign capacity slots: earlier routing rounds and earlier sequence
+    # positions win; a cumulative per-expert count carries across rounds.
+    counts = jnp.zeros((b, e), probs.dtype)
+    dispatch = jnp.zeros((b, s, e, capacity), probs.dtype)
+    combine = jnp.zeros((b, s, e, capacity), probs.dtype)
+    for m, g in zip(masks, gates):
+        pos = counts[:, None, :] + jnp.cumsum(m, axis=1) - m   # [b, s, e]
+        keep = m * (pos < capacity)
+        counts = counts + jnp.sum(keep, axis=1)
+        slots = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                               dtype=probs.dtype) * keep[..., None]
+        dispatch = dispatch + slots
+        combine = combine + g[..., None, None] * slots
+    return dispatch, combine, aux
+
+
+def moe_mlp(x: jax.Array, params: Dict[str, jax.Array], *,
+            top_k: int = 2, capacity_factor: float = 1.25,
+            compute_dtype=jnp.bfloat16,
+            mesh: Optional[jax.sharding.Mesh] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN block: route -> dispatch -> per-expert GELU MLP -> combine.
+
+    Args:
+      x: ``[b, s, d]`` activations.
+      params: ``router`` ``[d, e]``, ``wi`` ``[e, d, f]``, ``wo`` ``[e, f, d]``.
+
+    Returns: ``(y [b, s, d], aux_loss scalar)``.
+    """
+    e = params["wi"].shape[0]
+    s = x.shape[1]
+    cap = expert_capacity(s, e, top_k, capacity_factor)
+    dt = compute_dtype
+
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
+                        params["router"].astype(jnp.float32))
+    dispatch, combine, aux = top_k_routing(logits, top_k, cap)
+
+    def constrain(arr, *spec):
+        if mesh is None:
+            return arr
+        return sharding_lib.shard_constraint(
+            arr, mesh, jax.sharding.PartitionSpec(*spec))
+
+    # [b, e, c, d] — expert dim explicit so XLA partitions the expert matmuls
+    # over the `expert` axis (the dispatch einsum lowers to an all-to-all).
+    xe = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), x.astype(dt))
+    xe = constrain(xe, mesh_lib.BATCH_AXES, mesh_lib.EXPERT_AXIS, None, None)
+    h = jax.nn.gelu(jnp.einsum("becd,edf->becf", xe, params["wi"].astype(dt)))
+    h = constrain(h, mesh_lib.BATCH_AXES, mesh_lib.EXPERT_AXIS, None,
+                  mesh_lib.TENSOR_AXIS)
+    ye = jnp.einsum("becf,efd->becd", h, params["wo"].astype(dt))
+    ye = constrain(ye, mesh_lib.BATCH_AXES, mesh_lib.EXPERT_AXIS, None, None)
+    y = jnp.einsum("becd,bsec->bsd", ye, combine.astype(dt))
+    return y.astype(x.dtype), aux
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, num_experts: int
+                    ) -> Dict[str, jax.Array]:
+    kr, ki, ko = jax.random.split(rng, 3)
+    return {
+        "router": jax.random.normal(kr, (d_model, num_experts), jnp.float32)
+                  * (d_model ** -0.5),
+        "wi": jax.random.normal(ki, (num_experts, d_model, d_ff), jnp.float32)
+              * (d_model ** -0.5),
+        "wo": jax.random.normal(ko, (num_experts, d_ff, d_model), jnp.float32)
+              * (d_ff ** -0.5),
+    }
+
+
+def moe_logical_axes() -> Dict[str, Any]:
+    """Logical axis names for an `init_moe_params` tree (one layer)."""
+    return {
+        "router": (None, None),               # tiny; replicate
+        "wi": ("expert", "embed", "mlp"),
+        "wo": ("expert", "mlp", "embed"),
+    }

@@ -1,0 +1,106 @@
+"""MoE routing + expert-parallel training (no reference analog; SURVEY.md
+§2.4 records EP absent upstream — here it is first-class on the `expert`
+mesh axis)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import MeshConfig
+from ray_lightning_accelerators_tpu.ops.moe import (expert_capacity,
+                                                    init_moe_params,
+                                                    moe_mlp, top_k_routing)
+from tests.test_transformer import VOCAB, _fit
+
+
+def test_topk_routing_dispatches_to_argmax_expert():
+    # ample capacity, k=1: every token occupies exactly one slot of its
+    # argmax expert, with combine weight == renormalized gate == 1
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)
+    dispatch, combine, aux = top_k_routing(logits, top_k=1, capacity=16)
+    assert dispatch.shape == (2, 16, 4, 16)
+    # one slot per token
+    np.testing.assert_allclose(np.asarray(dispatch.sum((2, 3))), 1.0)
+    chosen = np.asarray(dispatch.sum(3).argmax(-1))
+    np.testing.assert_array_equal(chosen, np.asarray(logits.argmax(-1)))
+    # Switch-style k=1: combine weight is the RAW gate probability (keeps
+    # task-loss gradient flowing to the router), not renormalized to 1
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1).max(-1))
+    np.testing.assert_allclose(np.asarray(combine.sum((2, 3))), probs,
+                               atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_topk_routing_respects_capacity():
+    # force every token to expert 0 with capacity 2: only 2 tokens kept
+    logits = jnp.zeros((1, 8, 4)).at[..., 0].set(10.0)
+    dispatch, combine, _ = top_k_routing(logits, top_k=1, capacity=2)
+    kept = np.asarray(dispatch.sum((1, 3)))  # per-expert token counts
+    assert kept[0, 0] == 2.0 and kept[0, 1:].sum() == 0.0
+    # slots are unique: each (expert, slot) filled at most once
+    assert np.asarray(dispatch.sum(1)).max() <= 1.0
+
+
+def test_topk2_combine_weights_renormalized():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 8, 4)), jnp.float32)
+    dispatch, combine, _ = top_k_routing(logits, top_k=2, capacity=8)
+    np.testing.assert_allclose(np.asarray(dispatch.sum((2, 3))), 2.0)
+    np.testing.assert_allclose(np.asarray(combine.sum((2, 3))), 1.0,
+                               atol=1e-5)
+
+
+def test_moe_single_expert_equals_dense_mlp():
+    # E=1, k=1, ample capacity: MoE reduces to the dense GELU MLP (the raw
+    # Switch gate is softmax over one expert == exactly 1)
+    rng = jax.random.PRNGKey(0)
+    params = init_moe_params(rng, 32, 64, 1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32), jnp.float32)
+    y, aux = moe_mlp(x, params, top_k=1, capacity_factor=8.0,
+                     compute_dtype=jnp.float32)
+    dense = jnp.einsum("bsf,fd->bsd",
+                       jax.nn.gelu(jnp.einsum("bsd,df->bsf", x,
+                                              params["wi"][0])),
+                       params["wo"][0])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_expert_capacity_static():
+    assert expert_capacity(64, 4, 2, 1.0) == 32
+    assert expert_capacity(1, 8, 1, 1.0) == 1
+
+
+def _fit_moe(tmpdir, mesh_config, max_epochs=2, **cfg_kw):
+    return _fit(tmpdir, mesh_config, max_epochs=max_epochs,
+                num_experts=4, moe_top_k=2, **cfg_kw)
+
+
+@pytest.mark.parametrize("mesh_config", [
+    MeshConfig(data=2, expert=4),
+    MeshConfig(data=2, expert=2, tensor=2),
+], ids=["dp2-ep4", "dp2-ep2-tp2"])
+def test_moe_gpt_trains_expert_parallel(tmpdir, mesh_config):
+    trainer, model = _fit_moe(tmpdir, mesh_config)
+    assert trainer.callback_metrics["val_loss"] < float(jnp.log(VOCAB))
+    assert "moe_aux_loss" in trainer.callback_metrics
+
+
+def test_moe_expert_weights_sharded_on_expert_axis(tmpdir):
+    trainer, _ = _fit_moe(tmpdir, MeshConfig(data=2, expert=4))
+    wi = trainer._state.params["layers"]["mlp"]["wi"]  # (layers, E, d, f)
+    spec = wi.sharding.spec
+    assert spec[1] == "expert"
+    assert not wi.sharding.is_fully_replicated
+
+
+def test_moe_pipeline_raises(tmpdir):
+    with pytest.raises(NotImplementedError):
+        _fit_moe(tmpdir, MeshConfig(data=2, pipeline=2), n_layers=2)
+
+
+def test_topk_exceeding_experts_raises():
+    with pytest.raises(ValueError, match="top_k"):
+        top_k_routing(jnp.zeros((1, 4, 2)), top_k=4, capacity=4)
