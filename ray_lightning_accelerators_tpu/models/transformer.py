@@ -53,6 +53,10 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # streaming LM-head loss (ops/losses.py): never materializes the full
+    # [B,S,V] logits; engaged when the mesh doesn't shard seq/tensor/pipe
+    fused_loss: bool = True
+    loss_chunk_rows: int = 1024
 
     @property
     def head_dim(self) -> int:
@@ -215,7 +219,8 @@ class GPT(TpuModule):
         return self._constrain(h, mesh_lib.BATCH_AXES,
                                mesh_lib.SEQUENCE_AXIS, None), aux
 
-    def forward(self, params, batch, return_aux: bool = False):
+    def forward(self, params, batch, return_aux: bool = False,
+                return_hidden: bool = False):
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
@@ -251,11 +256,29 @@ class GPT(TpuModule):
         else:
             h, aux = stack(h, params["layers"])
         h = self._rms_norm(h, params["ln_f"])
-        unembed = (params["embed"].T if self.cfg.tie_embeddings
-                   else params["unembed"])
-        logits = jnp.einsum("bsd,dv->bsv", h, unembed.astype(dt))
+        if return_hidden:
+            return h, aux
+        logits = jnp.einsum("bsd,dv->bsv", h,
+                            self._unembed(params).astype(dt))
         logits = logits.astype(jnp.float32)
         return (logits, aux) if return_aux else logits
+
+    def _unembed(self, params) -> jax.Array:
+        return (params["embed"].T if self.cfg.tie_embeddings
+                else params["unembed"])
+
+    def _use_fused_loss(self) -> bool:
+        """Batch (data/fsdp) sharding is handled inside the op via
+        shard_map; seq/tensor/pipeline sharding of the hidden states or the
+        unembedding is not, so those fall back to the materialized path."""
+        if not self.cfg.fused_loss:
+            return False
+        if self.mesh is None:
+            return True
+        return all(
+            mesh_lib.mesh_axis_size(self.mesh, ax) == 1
+            for ax in (mesh_lib.SEQUENCE_AXIS, mesh_lib.TENSOR_AXIS,
+                       mesh_lib.PIPELINE_AXIS))
 
     # ------------------------------------------------------------------ #
     # Steps                                                              #
@@ -264,6 +287,16 @@ class GPT(TpuModule):
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
+        if self._use_fused_loss():
+            from ..ops.losses import fused_linear_cross_entropy
+            h, aux = self.forward(params, tokens, return_hidden=True)
+            d = h.shape[-1]
+            rows = h[:, :-1].reshape(-1, d)
+            targets = tokens[:, 1:].reshape(-1).astype(jnp.int32)
+            loss, acc = fused_linear_cross_entropy(
+                rows, self._unembed(params).astype(self.compute_dtype),
+                targets, self.cfg.loss_chunk_rows, mesh=self.mesh)
+            return loss, acc, aux
         logits, aux = self.forward(params, tokens, return_aux=True)
         targets = tokens[:, 1:]
         loss = optax.softmax_cross_entropy_with_integer_labels(
