@@ -57,6 +57,10 @@ class TransformerConfig:
     # [B,S,V] logits; engaged when the mesh doesn't shard seq/tensor/pipe
     fused_loss: bool = True
     loss_chunk_rows: int = 1024
+    # context-parallel strategy over the `sequence` mesh axis:
+    # "ring" (KV neighbor exchange) or "ulysses" (head/seq all-to-all;
+    # needs n_heads % sequence_axis == 0)
+    context_parallel: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -179,6 +183,10 @@ class GPT(TpuModule):
     def _attention(self, q, k, v):
         if self.mesh is not None and mesh_lib.mesh_axis_size(
                 self.mesh, mesh_lib.SEQUENCE_AXIS) > 1:
+            if self.cfg.context_parallel == "ulysses":
+                from ..parallel.ulysses import ulysses_attention_sharded
+                return ulysses_attention_sharded(q, k, v, self.mesh,
+                                                 causal=self.cfg.causal)
             return ring_attention_sharded(q, k, v, self.mesh,
                                           causal=self.cfg.causal)
         return flash_attention(q, k, v, self.cfg.causal)
