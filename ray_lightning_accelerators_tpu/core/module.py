@@ -38,6 +38,10 @@ class TpuModule:
         self.trainer = None              # backref set by Trainer
         self.compute_dtype = jnp.float32  # set from Trainer(precision=...)
         self.mesh = None                 # set by Trainer before tracing
+        # optional: an optax schedule (step -> lr).  Set it (and pass it to
+        # your optimizer) to get a per-step "lr" training metric
+        # (utils/schedules.py; wired in core/trainer.py's train_step)
+        self.lr_schedule = None
 
     # ------------------------------------------------------------------ #
     # Methods the user overrides.                                        #

@@ -215,6 +215,9 @@ class Trainer:
             new_params = optax.apply_updates(st.params, updates)
             new_state = st.replace(step=st.step + 1, params=new_params,
                                    opt_state=new_opt)
+            sched = getattr(module, "lr_schedule", None)
+            if callable(sched):  # evaluated in-trace; no host sync
+                metrics["lr"] = sched(st.step)
             return new_state, metrics
 
         def eval_step(params, batch):

@@ -86,12 +86,19 @@ class GPT(TpuModule):
 
     def __init__(self, config: Optional[TransformerConfig] = None,
                  lr: float = 3e-4, **cfg_overrides):
+        """``lr`` may be a float or an optax schedule (step -> lr), e.g.
+        ``utils.schedules.warmup_cosine(...)``; schedules are also exposed
+        as ``self.lr_schedule`` so the trainer logs per-step ``lr``."""
         super().__init__()
         if config is None:
             config = TransformerConfig(**cfg_overrides)
         self.cfg = config
         self.lr = lr
-        self.save_hyperparameters(config=dataclasses.asdict(config), lr=lr)
+        if callable(lr):
+            self.lr_schedule = lr
+        # a schedule callable is not checkpoint-serializable; record its repr
+        self.save_hyperparameters(config=dataclasses.asdict(config),
+                                  lr=repr(lr) if callable(lr) else lr)
 
     # ------------------------------------------------------------------ #
     # Parameters                                                         #

@@ -1,0 +1,114 @@
+"""LR schedule shapes + the trainer's per-step lr metric."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_lightning_accelerators_tpu.utils import schedules
+
+
+def _eval(sched, steps):
+    return np.asarray([float(sched(jnp.asarray(s))) for s in steps])
+
+
+def test_warmup_cosine_shape():
+    s = schedules.warmup_cosine(1.0, total_steps=100, warmup_steps=10,
+                                end_lr=0.1)
+    vals = _eval(s, [0, 5, 10, 55, 100])
+    assert vals[0] == pytest.approx(0.0)
+    assert vals[1] == pytest.approx(0.5, abs=0.05)   # mid-warmup
+    assert vals[2] == pytest.approx(1.0)             # peak
+    assert 0.1 < vals[3] < 1.0                       # decaying
+    assert vals[4] == pytest.approx(0.1, abs=1e-6)   # floor
+
+
+def test_warmup_linear_shape():
+    s = schedules.warmup_linear(2.0, total_steps=100, warmup_steps=20)
+    vals = _eval(s, [0, 10, 20, 60, 100])
+    assert vals[0] == pytest.approx(0.0)
+    assert vals[1] == pytest.approx(1.0)
+    assert vals[2] == pytest.approx(2.0)
+    assert vals[3] == pytest.approx(1.0)
+    assert vals[4] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_step_decay():
+    s = schedules.step_decay(1.0, {30: 0.1, 60: 0.1})
+    vals = _eval(s, [0, 29, 31, 61])
+    np.testing.assert_allclose(vals, [1.0, 1.0, 0.1, 0.01], rtol=1e-5)
+
+
+def test_inverse_sqrt():
+    s = schedules.inverse_sqrt(1.0, warmup_steps=16)
+    vals = _eval(s, [0, 8, 16, 64])
+    assert vals[0] == pytest.approx(1 / 16)  # step clamps to 1 in warmup
+    assert vals[1] == pytest.approx(0.5)
+    assert vals[2] == pytest.approx(1.0)
+    assert vals[3] == pytest.approx(0.5)  # sqrt(16/64)
+
+
+def test_wsd_plateau_and_decay():
+    s = schedules.wsd(1.0, total_steps=100, warmup_steps=10, decay_steps=20,
+                      end_lr=0.0)
+    vals = _eval(s, [0, 5, 10, 50, 79, 90, 100])
+    assert vals[0] == pytest.approx(0.0)
+    assert vals[1] == pytest.approx(0.5)
+    assert vals[2] == pytest.approx(1.0)
+    assert vals[3] == pytest.approx(1.0)   # stable plateau
+    assert vals[4] == pytest.approx(1.0, abs=0.06)
+    assert 0.0 < vals[5] < 1.0             # decaying
+    assert vals[6] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_schedule_is_jittable():
+    s = schedules.wsd(3e-4, total_steps=1000, warmup_steps=100,
+                      decay_steps=100)
+    out = jax.jit(jax.vmap(s))(jnp.arange(0, 1000, 100))
+    assert out.shape == (10,)
+
+
+def test_trainer_logs_lr_metric():
+    from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                                Trainer)
+    from tests.utils import BoringModel
+
+    sched = schedules.warmup_linear(1e-2, total_steps=8, warmup_steps=4)
+
+    class SchedModel(BoringModel):
+        def __init__(self):
+            super().__init__()
+            self.lr_schedule = sched
+
+        def configure_optimizers(self):
+            return optax.sgd(sched)
+
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+    model = SchedModel()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False, log_every_n_steps=1,
+                      default_root_dir="/tmp/lr_sched_test")
+    trainer.fit(model, DataLoader(ArrayDataset(x), batch_size=8))
+    assert "lr" in trainer.callback_metrics
+    # last step index seen by the schedule inside the final update is 7
+    assert trainer.callback_metrics["lr"] == pytest.approx(
+        float(sched(jnp.asarray(7))), rel=1e-5)
+
+
+def test_gpt_accepts_schedule():
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    sched = schedules.warmup_cosine(1e-3, total_steps=100, warmup_steps=10)
+    model = GPT(TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                  d_ff=64, n_layers=1, max_seq_len=16),
+                lr=sched)
+    assert model.lr_schedule is sched
+    tx = model.configure_optimizers()
+    params = model.init_params(jax.random.PRNGKey(0))
+    state = tx.init(params)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    grads = jax.grad(lambda p: model.training_step(
+        p, toks, jax.random.PRNGKey(0))[0])(params)
+    updates, _ = tx.update(grads, state, params)
+    assert jax.tree.leaves(updates)[0] is not None
