@@ -198,7 +198,7 @@ class GPT(TpuModule):
                                           causal=self.cfg.causal)
         return flash_attention(q, k, v, self.cfg.causal)
 
-    def _block(self, h, layer_params, positions):
+    def _block(self, h, layer_params, positions, return_kv: bool = False):
         cfg = self.cfg
         dt = self.compute_dtype
         a = layer_params["attn"]
@@ -231,8 +231,11 @@ class GPT(TpuModule):
                                  mesh_lib.SEQUENCE_AXIS,
                                  mesh_lib.TENSOR_AXIS)
             h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
-        return self._constrain(h, mesh_lib.BATCH_AXES,
-                               mesh_lib.SEQUENCE_AXIS, None), aux
+        h = self._constrain(h, mesh_lib.BATCH_AXES,
+                            mesh_lib.SEQUENCE_AXIS, None)
+        if return_kv:
+            return h, aux, k, v
+        return h, aux
 
     def forward(self, params, batch, return_aux: bool = False,
                 return_hidden: bool = False):
@@ -337,3 +340,137 @@ class GPT(TpuModule):
 
     def configure_optimizers(self):
         return optax.adamw(self.lr, weight_decay=0.01)
+
+    # ------------------------------------------------------------------ #
+    # Autoregressive generation (KV cache)                               #
+    # ------------------------------------------------------------------ #
+    # TPU-first decode: everything is static-shaped — the cache is
+    # allocated at [L, B, H, total_len, D] up front, the decode loop is a
+    # single lax.scan (one trace, one compile regardless of token count),
+    # and per-step cache writes are dynamic_update_slice at a traced
+    # position.  No reference analog (predict there is plain model(x),
+    # reference: ray_lightning/tests/utils.py:137-152).
+
+    def _prefill(self, params, tokens, total_len):
+        """Run the prompt once; returns (last-position hidden [B,d],
+        cache dict with k/v [L,B,H,total_len,D])."""
+        dt = self.compute_dtype
+        h = params["embed"].astype(dt)[tokens]
+        pos = jnp.arange(tokens.shape[1])
+
+        def block(carry, lp):
+            h_new, _, k, v = self._block(carry, lp, pos, return_kv=True)
+            return h_new, (k, v)
+
+        h, (ks, vs) = jax.lax.scan(block, h, params["layers"])
+        pad = total_len - tokens.shape[1]
+        cache = {
+            "k": jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))),
+            "v": jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))),
+        }
+        h = self._rms_norm(h, params["ln_f"])
+        return h[:, -1], cache
+
+    def _decode_block(self, h, lp, ck, cv, pos):
+        """One layer, one token.  h: [B,1,d]; ck/cv: [B,H,total,D] with this
+        layer's keys/values for positions < pos already written.  Returns
+        (h_out, k_new, v_new) where k/v_new are this token's projections."""
+        cfg = self.cfg
+        dt = self.compute_dtype
+        a = lp["attn"]
+        x = self._rms_norm(h, lp["ln1"])
+        positions = pos[None]  # [1]
+        q = jnp.einsum("bsd,dhk->bhsk", x, a["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bhsk", x, a["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bhsk", x, a["wv"].astype(dt))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, 0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, 0, pos, 0))
+        # single-query attention over the cache, masked to written slots
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) * cfg.head_dim ** -0.5
+        mask = jnp.arange(ck.shape[2]) <= pos
+        s = jnp.where(mask[None, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", p, cv.astype(jnp.float32)
+                          ).astype(dt)
+        h = h + jnp.einsum("bhsk,hkd->bsd", attn, a["wo"].astype(dt))
+        x = self._rms_norm(h, lp["ln2"])
+        m = lp["mlp"]
+        if cfg.num_experts > 1:
+            y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           compute_dtype=dt, mesh=self.mesh)
+            h = h + y
+        else:
+            up = jax.nn.gelu(
+                jnp.einsum("bsd,df->bsf", x, m["wi"].astype(dt)))
+            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
+        return h, ck, cv
+
+    def _decode_token(self, params, cache, token, pos):
+        """Full-depth single-token step.  token: [B] int32.  Returns
+        (logits [B,V] f32, updated cache)."""
+        dt = self.compute_dtype
+        h = params["embed"].astype(dt)[token][:, None]  # [B,1,d]
+
+        def layer(carry, xs):
+            h_in = carry
+            lp, ck, cv = xs
+            h_out, ck2, cv2 = self._decode_block(h_in, lp, ck, cv, pos)
+            return h_out, (ck2, cv2)
+
+        h, (cks, cvs) = jax.lax.scan(
+            layer, h, (params["layers"], cache["k"], cache["v"]))
+        h = self._rms_norm(h, params["ln_f"])
+        logits = (h[:, 0] @ self._unembed(params).astype(dt)
+                  ).astype(jnp.float32)
+        return logits, {"k": cks, "v": cvs}
+
+    @staticmethod
+    def _sample(logits, temperature, top_k, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+    def generate(self, params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 rng: Optional[jax.Array] = None) -> jax.Array:
+        """Greedy (temperature=0) or sampled decode.  prompt: [B, S0] int32.
+        Returns [B, S0 + max_new_tokens].  Jit-compatible: wrap in jax.jit
+        with static max_new_tokens/temperature/top_k for the compiled path.
+        """
+        prompt = jnp.asarray(prompt, jnp.int32)
+        b, s0 = prompt.shape
+        total = s0 + max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(f"prompt + new tokens ({total}) exceeds "
+                             f"max_seq_len ({self.cfg.max_seq_len})")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        h_last, cache = self._prefill(params, prompt, total)
+        dt = self.compute_dtype
+        logits0 = (h_last @ self._unembed(params).astype(dt)
+                   ).astype(jnp.float32)
+        rng, r0 = jax.random.split(rng)
+        tok0 = self._sample(logits0, temperature, top_k, r0)
+
+        def step(carry, i):
+            cache, tok, rng = carry
+            logits, cache = self._decode_token(params, cache, tok, s0 + i)
+            rng, r = jax.random.split(rng)
+            nxt = self._sample(logits, temperature, top_k, r)
+            return (cache, nxt, rng), nxt
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (cache, tok0, rng), jnp.arange(max_new_tokens - 1))
+        out = jnp.concatenate(
+            [prompt, tok0[:, None], toks.transpose(1, 0)], axis=1)
+        return out

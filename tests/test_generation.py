@@ -1,0 +1,93 @@
+"""KV-cache autoregressive decode vs naive full re-forward generation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.models.transformer import (
+    GPT, TransformerConfig)
+
+
+def _model(vocab=97, heads=2, experts=1, max_seq_len=48,
+           capacity_factor=1.25):
+    cfg = TransformerConfig(vocab_size=vocab, d_model=64, n_heads=heads,
+                            d_ff=128, n_layers=3, max_seq_len=max_seq_len,
+                            num_experts=experts,
+                            moe_capacity_factor=capacity_factor)
+    m = GPT(cfg)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _naive_generate(model, params, prompt, n_new):
+    """Re-forward the whole prefix for every token: the reference semantics
+    the cache path must reproduce exactly."""
+    toks = jnp.asarray(prompt, jnp.int32)
+    for _ in range(n_new):
+        logits = model.forward(params, toks)
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return toks
+
+
+def test_cached_decode_matches_naive():
+    model, params = _model()
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, size=(2, 8)), jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=10)
+    ref = _naive_generate(model, params, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_is_jittable():
+    model, params = _model()
+    prompt = jnp.ones((1, 4), jnp.int32)
+    gen = jax.jit(lambda p, t: model.generate(p, t, max_new_tokens=6))
+    out = gen(params, prompt)
+    assert out.shape == (1, 10)
+    ref = _naive_generate(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_single_new_token():
+    model, params = _model()
+    prompt = jnp.ones((2, 4), jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=1)
+    assert out.shape == (2, 5)
+    ref = _naive_generate(model, params, prompt, 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sampling_reproducible_and_in_vocab():
+    model, params = _model()
+    prompt = jnp.ones((2, 4), jnp.int32)
+    a = model.generate(params, prompt, max_new_tokens=8, temperature=0.8,
+                       top_k=10, rng=jax.random.PRNGKey(7))
+    b = model.generate(params, prompt, max_new_tokens=8, temperature=0.8,
+                       top_k=10, rng=jax.random.PRNGKey(7))
+    c = model.generate(params, prompt, max_new_tokens=8, temperature=0.8,
+                       top_k=10, rng=jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert np.all(np.asarray(a) >= 0) and np.all(np.asarray(a) < 97)
+
+
+def test_overflow_raises():
+    model, params = _model(max_seq_len=16)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.generate(params, jnp.ones((1, 10), jnp.int32),
+                       max_new_tokens=10)
+
+
+def test_moe_decode_matches_naive():
+    # capacity high enough that no token is ever dropped: routing is then
+    # per-token independent, so full-seq prefill and 1-token decode agree
+    # (with drops, routing depends on batch composition and exact match is
+    # not a well-defined expectation)
+    model, params = _model(experts=4, heads=2, capacity_factor=8.0)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, 97, size=(2, 8)), jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=6)
+    ref = _naive_generate(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
