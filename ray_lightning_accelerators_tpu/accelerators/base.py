@@ -30,10 +30,16 @@ class Accelerator:
 
     def __init__(self, mesh_config: Optional[mesh_lib.MeshConfig] = None,
                  init_hook: Optional[Callable[[], None]] = None,
-                 use_fsdp: bool = False):
+                 use_fsdp: bool = False,
+                 dcn_data: int = 1, dcn_pipeline: int = 1):
         self.mesh_config = mesh_config or mesh_lib.MeshConfig()
         self.init_hook = init_hook
         self.use_fsdp = use_fsdp
+        # multi-slice: replicate the per-slice (ICI) mesh across slices on
+        # the data / pipeline axes over DCN (parallel/mesh.py
+        # build_hybrid_mesh); 1 x 1 = single slice
+        self.dcn_data = dcn_data
+        self.dcn_pipeline = dcn_pipeline
         self._mesh: Optional[Mesh] = None
 
     # ---------------------------------------------------------------- #
@@ -65,8 +71,13 @@ class Accelerator:
 
     def build_mesh(self) -> Mesh:
         if self._mesh is None:
-            self._mesh = mesh_lib.build_mesh(self.mesh_config,
-                                             self.select_devices())
+            if self.dcn_data * self.dcn_pipeline > 1:
+                # multi-slice spans every visible device; no truncation
+                self._mesh = mesh_lib.build_hybrid_mesh(
+                    self.mesh_config, self.dcn_data, self.dcn_pipeline)
+            else:
+                self._mesh = mesh_lib.build_mesh(self.mesh_config,
+                                                 self.select_devices())
         return self._mesh
 
     @property

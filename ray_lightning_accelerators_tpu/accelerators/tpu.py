@@ -42,6 +42,7 @@ class RayTPUAccelerator(Accelerator):
     def __init__(self, num_workers: Optional[int] = None, *,
                  use_fsdp: bool = False, tensor: int = 1, sequence: int = 1,
                  pipeline: int = 1, expert: int = 1,
+                 dcn_data: int = 1, dcn_pipeline: int = 1,
                  init_hook: Optional[Callable[[], None]] = None):
         dp = -1 if num_workers is None else num_workers
         if use_fsdp:
@@ -52,7 +53,8 @@ class RayTPUAccelerator(Accelerator):
             cfg = mesh_lib.MeshConfig(data=dp, tensor=tensor,
                                       sequence=sequence, pipeline=pipeline,
                                       expert=expert)
-        super().__init__(cfg, init_hook=init_hook, use_fsdp=use_fsdp)
+        super().__init__(cfg, init_hook=init_hook, use_fsdp=use_fsdp,
+                         dcn_data=dcn_data, dcn_pipeline=dcn_pipeline)
         self.num_workers = num_workers
 
     def select_devices(self):

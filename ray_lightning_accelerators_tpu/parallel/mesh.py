@@ -88,6 +88,21 @@ class MeshConfig:
         return sizes
 
 
+def _device_array(shape: tuple, devices: list) -> np.ndarray:
+    """Physical-topology-aware device layout on real TPU (mesh_utils maps
+    logical axes onto the ICI torus so neighbouring mesh coordinates are
+    ICI neighbours); plain reshape elsewhere (CPU test meshes, single
+    device, or shapes mesh_utils rejects)."""
+    if len(devices) > 1 and getattr(devices[0], "platform", "") == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+            return mesh_utils.create_device_mesh(
+                shape, devices, allow_split_physical_axes=True)
+        except Exception:
+            pass
+    return np.asarray(devices, dtype=object).reshape(shape)
+
+
 def build_mesh(config: Optional[MeshConfig] = None,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Construct a named Mesh over `devices` (default: all devices).
@@ -101,8 +116,50 @@ def build_mesh(config: Optional[MeshConfig] = None,
     devices = list(devices)
     sizes = config.axis_sizes(len(devices))
     shape = tuple(sizes[a] for a in AXIS_ORDER)
-    dev_array = np.asarray(devices, dtype=object).reshape(shape)
-    return Mesh(dev_array, AXIS_ORDER)
+    return Mesh(_device_array(shape, devices), AXIS_ORDER)
+
+
+def hybrid_device_array(ici_shape: tuple, dcn_shape: tuple,
+                        devices: list) -> np.ndarray:
+    """Group devices into slices (granules) and lay out a mesh whose outer
+    (DCN) axes cross slices and inner (ICI) axes stay within one slice."""
+    from jax.experimental import mesh_utils
+    return mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices,
+        process_is_granule=not hasattr(devices[0], "slice_index"),
+        allow_split_physical_axes=True)
+
+
+def build_hybrid_mesh(config: Optional[MeshConfig] = None,
+                      dcn_data: int = 1, dcn_pipeline: int = 1,
+                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Multi-slice mesh: ICI axes (from ``config``, sized per slice) within
+    each slice, DCN axes across slices.
+
+    Only `data` and `pipeline` may cross DCN — they are the axes whose
+    collectives tolerate slow links (per-step gradient all-reduce
+    respectively stage-boundary point-to-point).  The TPU-native analog of
+    the reference's multi-node story (Ray cluster over TCP,
+    reference: README.md:57-62; SURVEY.md §2.3 DCN row): the resulting axis
+    size is ici*dcn, e.g. 2 slices of 4 chips with ``data=4, dcn_data=2``
+    give an 8-wide data axis whose inner 4-groups all-reduce over ICI first.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n_dcn = dcn_data * dcn_pipeline
+    if n_dcn == 1:
+        return build_mesh(config, devices)
+    if len(devices) % n_dcn:
+        raise ValueError(f"{len(devices)} devices not divisible into "
+                         f"{n_dcn} DCN groups")
+    ici_sizes = config.axis_sizes(len(devices) // n_dcn)
+    ici_shape = tuple(ici_sizes[a] for a in AXIS_ORDER)
+    dcn_by_axis = {DATA_AXIS: dcn_data, PIPELINE_AXIS: dcn_pipeline}
+    dcn_shape = tuple(dcn_by_axis.get(a, 1) for a in AXIS_ORDER)
+    return Mesh(hybrid_device_array(ici_shape, dcn_shape, devices),
+                AXIS_ORDER)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
