@@ -104,6 +104,12 @@ class EarlyStopping(Callback):
         self.stopped_epoch = state.get("stopped_epoch")
 
 
+def _remove_checkpoint(path: str) -> None:
+    """Evict a checkpoint: a pickle file or a sharded directory."""
+    from ..utils.sharded_checkpoint import remove_checkpoint
+    remove_checkpoint(path)
+
+
 class ModelCheckpoint(Callback):
     """Save checkpoints, tracking the best by `monitor`.
 
@@ -149,8 +155,7 @@ class ModelCheckpoint(Callback):
                 self._saved.append((0.0, self.best_model_path))
                 while len(self._saved) > max(0, self.save_top_k - 1):
                     _, evicted = self._saved.pop(0)
-                    if os.path.exists(evicted):
-                        os.unlink(evicted)
+                    _remove_checkpoint(evicted)
             self.best_model_path = path
             return
         current = trainer.callback_metrics.get(self.monitor)
@@ -167,8 +172,8 @@ class ModelCheckpoint(Callback):
                              reverse=(self.mode == "max"))
             while len(self._saved) > self.save_top_k:
                 _, evicted = self._saved.pop()
-                if os.path.exists(evicted) and evicted != path:
-                    os.unlink(evicted)
+                if evicted != path:
+                    _remove_checkpoint(evicted)
             if self._is_better(current, self.best_model_score):
                 self.best_model_score = current
                 self.best_model_path = path

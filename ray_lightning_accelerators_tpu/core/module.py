@@ -113,10 +113,19 @@ class TpuModule:
         reference: ray_lightning/tests/utils.py:129-134).
         """
         from ..utils import checkpoint as ckpt_lib
-        payload = ckpt_lib.read_checkpoint(checkpoint_path)
+        from ..utils import sharded_checkpoint as sharded_lib
+        sharded = sharded_lib.is_sharded_checkpoint(checkpoint_path)
+        payload = (sharded_lib.read_metadata(checkpoint_path) if sharded
+                   else ckpt_lib.read_checkpoint(checkpoint_path))
         mod = module if module is not None else cls(**payload.get("hparams", init_kwargs) or init_kwargs)
         rng = jax.random.PRNGKey(0)
         template = mod.init_params(rng)
-        mod.params = ckpt_lib.restore_params(payload, template)
+        if sharded:
+            import flax.serialization
+            state = sharded_lib.restore_sharded(checkpoint_path)
+            mod.params = flax.serialization.from_state_dict(
+                template, flax.serialization.to_state_dict(state)["params"])
+        else:
+            mod.params = ckpt_lib.restore_params(payload, template)
         mod.on_load_checkpoint(payload)
         return mod

@@ -69,6 +69,7 @@ class Trainer:
                  accumulate_grad_batches: int = 1,
                  gradient_clip_val: Optional[float] = None,
                  enable_checkpointing: bool = True,
+                 checkpoint_format: str = "pickle",
                  num_sanity_val_steps: int = 0,
                  enable_progress_bar: bool = False,
                  profiler: Optional["Profiler"] = None,
@@ -96,6 +97,12 @@ class Trainer:
         self.accumulate_grad_batches = max(1, accumulate_grad_batches)
         self.gradient_clip_val = gradient_clip_val
         self.enable_checkpointing = enable_checkpointing
+        # "pickle": single-file, rank-0 host gather (reference-shaped).
+        # "sharded": every process writes its own shards (orbax; scales to
+        # pods).  "sharded-async": same, committed by a background thread.
+        if checkpoint_format not in ("pickle", "sharded", "sharded-async"):
+            raise ValueError(f"unknown checkpoint_format {checkpoint_format!r}")
+        self.checkpoint_format = checkpoint_format
         self.num_sanity_val_steps = num_sanity_val_steps
         self.enable_progress_bar = enable_progress_bar
         self.profiler = profiler
@@ -136,7 +143,7 @@ class Trainer:
                 return c
         return None
 
-    def dump_checkpoint(self) -> Dict[str, Any]:
+    def dump_checkpoint(self, include_state: bool = True) -> Dict[str, Any]:
         cb_states = {}
         for c in self.callbacks:
             st = c.state_dict()
@@ -146,7 +153,8 @@ class Trainer:
         # loop; a max_steps-truncated epoch does not count), so a resumed run
         # neither repeats the epoch that produced the save nor skips ahead
         payload = ckpt_lib.build_checkpoint(
-            self._state, self.epochs_completed, self.global_step,
+            self._state if include_state else None,
+            self.epochs_completed, self.global_step,
             hparams=getattr(self.module, "hparams", {}), callbacks=cb_states)
         if self.module is not None:
             self.module.on_save_checkpoint(payload)
@@ -155,12 +163,24 @@ class Trainer:
         return payload
 
     def save_checkpoint(self, filepath: str) -> None:
-        if jax.process_index() == 0:
+        if self.checkpoint_format != "pickle":
+            # every process participates (each writes its own shards)
+            from ..utils import sharded_checkpoint as sharded_lib
+            meta = self.dump_checkpoint(include_state=False)
+            sharded_lib.save_sharded(
+                filepath, self._state, meta,
+                async_save=self.checkpoint_format == "sharded-async")
+        elif jax.process_index() == 0:
             ckpt_lib.atomic_save(self.dump_checkpoint(), filepath)
 
     def _restore(self, ckpt_path: str, state: TrainState) -> TrainState:
-        payload = ckpt_lib.read_checkpoint(ckpt_path)
-        state = ckpt_lib.restore_state(payload, state)
+        from ..utils import sharded_checkpoint as sharded_lib
+        if sharded_lib.is_sharded_checkpoint(ckpt_path):
+            payload = sharded_lib.read_metadata(ckpt_path)
+            state = sharded_lib.restore_sharded(ckpt_path, template=state)
+        else:
+            payload = ckpt_lib.read_checkpoint(ckpt_path)
+            state = ckpt_lib.restore_state(payload, state)
         self.current_epoch = payload["epoch"]
         self.epochs_completed = payload["epoch"]
         self.global_step = payload["global_step"]
@@ -510,6 +530,9 @@ class Trainer:
         module.params = jax.device_get(state.params)
         for c in self.callbacks:
             c.on_fit_end(self, module)
+        if self.checkpoint_format == "sharded-async":
+            from ..utils import sharded_checkpoint as sharded_lib
+            sharded_lib.wait_until_finished()  # fence in-flight saves
         self.fitting = False
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()

@@ -48,12 +48,13 @@ def build_checkpoint(state, epoch: int, global_step: int,
                      extra: Dict[str, Any] | None = None) -> Dict[str, Any]:
     payload = {
         "format_version": 1,
-        "state": _to_host_state_dict(state),
         "epoch": int(epoch),
         "global_step": int(global_step),
         "hparams": dict(hparams or {}),
         "callbacks": dict(callbacks or {}),
     }
+    if state is not None:  # None = arrays stored separately (sharded path)
+        payload["state"] = _to_host_state_dict(state)
     if extra:
         payload.update(extra)
     return payload
@@ -87,6 +88,11 @@ def latest_checkpoint(directory: str, pattern: str = "*.ckpt") -> str | None:
     candidates = glob.glob(os.path.join(glob.escape(directory), "**", pattern),
                            recursive=True)
     candidates = [c for c in candidates if os.path.isfile(c)]
+    # sharded checkpoints are directories marked complete by their meta.json
+    from . import sharded_checkpoint as sharded_lib
+    candidates += [os.path.dirname(m) for m in glob.glob(
+        os.path.join(glob.escape(directory), "**", sharded_lib.META_FILE),
+        recursive=True)]
     if not candidates:
         return None
     return max(candidates, key=os.path.getmtime)

@@ -1,0 +1,137 @@
+"""Sharded + async checkpointing (orbax/tensorstore backend).
+
+The pickle path (utils/checkpoint.py) gathers the FULL train state onto
+process 0's host memory and writes one file — the direct analog of the
+reference's rank-0 ``dump_checkpoint`` shipping (reference:
+ray_lightning/tune.py:128-142), and exactly what does not scale once params
+are sharded over a pod: the gather re-materializes every FSDP shard on one
+host and serializes the write behind a single NIC.
+
+This module is the TPU-native path:
+
+- **save**: every process writes its own array shards in parallel (orbax /
+  tensorstore OCDBT); no cross-host gather, IO bandwidth scales with hosts.
+- **restore**: pass abstract arrays carrying target shardings and each
+  process reads only the bytes its devices need — a pod restores a
+  checkpoint without any host ever holding the full state.
+- **async**: ``sharded-async`` hands the device arrays to a background
+  committer so training continues while bytes hit disk
+  (``wait_until_finished`` fences).
+
+Layout: ``<path>/state/`` (orbax tree) + ``<path>/meta.json`` (epoch, step,
+hparams, callback states — the non-array half of the payload).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+
+STATE_DIR = "state"
+META_FILE = "meta.json"
+
+_sync_ckptr = None
+_async_ckptr = None
+
+
+def _checkpointer(async_save: bool):
+    global _sync_ckptr, _async_ckptr
+    import orbax.checkpoint as ocp
+    if async_save:
+        if _async_ckptr is None:
+            _async_ckptr = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+        return _async_ckptr
+    if _sync_ckptr is None:
+        _sync_ckptr = ocp.StandardCheckpointer()
+    return _sync_ckptr
+
+
+def wait_until_finished() -> None:
+    """Fence any in-flight async save (no-op when none)."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, META_FILE))
+
+
+def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
+                 async_save: bool = False) -> None:
+    """Write ``state`` (a pytree of [possibly sharded] jax arrays) under
+    ``path`` with every process writing its own shards.  ``metadata`` must
+    be JSON-serializable; it is written by process 0 only, LAST, so a
+    completed ``meta.json`` marks a complete checkpoint (torn writes are
+    invisible to ``is_sharded_checkpoint``/``latest_checkpoint``)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = _checkpointer(async_save)
+    if async_save:
+        ckptr.save(os.path.join(path, STATE_DIR),
+                   args=ocp.args.StandardSave(state), force=True)
+    else:
+        ckptr.save(os.path.join(path, STATE_DIR), state, force=True)
+    if jax.process_index() == 0:
+        tmp = os.path.join(path, META_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(metadata, f)
+        if async_save:
+            # rename only once the array commit completes; the async
+            # checkpointer exposes that as a finalize callback-free wait,
+            # so fence here cheaply via a deferred rename thread
+            import threading
+
+            def _finalize():
+                _async_ckptr.wait_until_finished()
+                os.replace(tmp, os.path.join(path, META_FILE))
+
+            threading.Thread(target=_finalize, daemon=True).start()
+        else:
+            os.replace(tmp, os.path.join(path, META_FILE))
+
+
+def read_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, META_FILE)) as f:
+        return json.load(f)
+
+
+def restore_sharded(path: str, template: Optional[Any] = None,
+                    shardings: Optional[Any] = None) -> Any:
+    """Restore the state tree saved under ``path``.
+
+    - ``template`` (a pytree matching the saved structure) makes restore
+      structure-checked; with ``shardings`` (a matching pytree of
+      ``NamedSharding``) each leaf comes back already device-put with that
+      sharding and each process reads only its shards.
+    - with neither, the tree comes back in saved structure on default
+      devices (single-host convenience path).
+    """
+    wait_until_finished()
+    ckptr = _checkpointer(False)
+    state_path = os.path.join(os.path.abspath(path), STATE_DIR)
+    if template is None:
+        return ckptr.restore(state_path)
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(
+                jax.numpy.shape(x), x.dtype, sharding=s),
+            template, shardings)
+    else:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jax.numpy.shape(x), x.dtype),
+            template)
+    return ckptr.restore(state_path, abstract)
+
+
+def remove_checkpoint(path: str) -> None:
+    """Delete a checkpoint, whether a pickle file or a sharded directory."""
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    elif os.path.exists(path):
+        os.unlink(path)
