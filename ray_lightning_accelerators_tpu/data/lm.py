@@ -1,0 +1,111 @@
+"""Language-model data pipeline: tokenization + sequence packing.
+
+No reference analog (the reference's data story stops at MNIST tensors,
+reference: examples/ray_ddp_example.py:40-59); an LM flagship needs the
+text path.  TPU-first constraints drive the design: the train step is
+compiled for ONE static [batch, seq_len] shape, so variable-length
+documents must be **packed** into fixed-length rows host-side — padding
+minimized up front rather than masked per step — and the packed corpus is
+a single int32 array that drops straight into ``ArrayDataset`` (and thus
+the device-resident cache fast path, core/trainer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .loader import ArrayDataset
+
+
+class CharTokenizer:
+    """Character-level tokenizer with a corpus-derived vocabulary.
+
+    Deterministic (sorted vocab), dependency-free, reversible.  Reserves
+    id 0 for padding and id 1 for end-of-text.
+    """
+
+    PAD_ID = 0
+    EOS_ID = 1
+
+    def __init__(self, corpus: str):
+        chars = sorted(set(corpus))
+        self._to_id: Dict[str, int] = {c: i + 2 for i, c in enumerate(chars)}
+        self._to_char: Dict[int, str] = {i: c for c, i in self._to_id.items()}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._to_id) + 2
+
+    def encode(self, text: str) -> List[int]:
+        try:
+            return [self._to_id[c] for c in text]
+        except KeyError as e:
+            raise ValueError(f"character {e.args[0]!r} not in vocabulary")
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return "".join(self._to_char.get(int(i), "") for i in ids)
+
+
+def pack_sequences(docs: Sequence[Sequence[int]], seq_len: int,
+                   eos_id: Optional[int] = CharTokenizer.EOS_ID,
+                   drop_remainder: bool = True,
+                   pad_id: int = CharTokenizer.PAD_ID) -> np.ndarray:
+    """Concatenate token documents (with an ``eos_id`` separator after each
+    unless None) and slice into fixed [N, seq_len] rows.
+
+    ``drop_remainder=False`` pads the final partial row with ``pad_id``
+    (mask pad targets downstream; ops/losses.py treats negative targets as
+    masked, so shift-pad accordingly).  Packing wastes no tokens on
+    per-document padding — the standard LM pretraining layout and the only
+    one that keeps every MXU row busy.
+    """
+    stream: List[int] = []
+    for d in docs:
+        stream.extend(int(t) for t in d)
+        if eos_id is not None:
+            stream.append(eos_id)
+    n_full = len(stream) // seq_len
+    if drop_remainder or len(stream) % seq_len == 0:
+        arr = np.asarray(stream[:n_full * seq_len], np.int32)
+        return arr.reshape(n_full, seq_len)
+    pad = (n_full + 1) * seq_len - len(stream)
+    arr = np.asarray(stream + [pad_id] * pad, np.int32)
+    return arr.reshape(n_full + 1, seq_len)
+
+
+def lm_dataset(text: str, seq_len: int,
+               tokenizer: Optional[CharTokenizer] = None):
+    """(ArrayDataset of packed [N, seq_len] rows, tokenizer) for a corpus.
+
+    Documents are split on blank lines; each gets an EOS separator.
+    """
+    tokenizer = tokenizer or CharTokenizer(text)
+    docs = [tokenizer.encode(d) for d in text.split("\n\n") if d]
+    packed = pack_sequences(docs, seq_len)
+    if not len(packed):
+        raise ValueError(
+            f"corpus too small for even one row of seq_len={seq_len}")
+    return ArrayDataset(packed), tokenizer
+
+
+def synthetic_corpus(n_sentences: int = 200, seed: int = 0) -> str:
+    """Tiny grammar-driven corpus with learnable structure (for examples,
+    tests, and benches — no downloads in this environment)."""
+    rng = np.random.default_rng(seed)
+    subjects = ["the pod", "a chip", "the mesh", "an actor", "the trainer",
+                "a worker"]
+    verbs = ["shards", "compiles", "reduces", "gathers", "schedules",
+             "restores"]
+    objects = ["the batch", "every gradient", "a checkpoint", "the ring",
+               "its state", "the queue"]
+    sents = []
+    for _ in range(n_sentences):
+        s = (f"{subjects[rng.integers(len(subjects))]} "
+             f"{verbs[rng.integers(len(verbs))]} "
+             f"{objects[rng.integers(len(objects))]}.")
+        sents.append(s)
+    # paragraphs of ~5 sentences = documents for the packer
+    paras = [" ".join(sents[i:i + 5]) for i in range(0, len(sents), 5)]
+    return "\n\n".join(paras)

@@ -448,6 +448,9 @@ class GPT(TpuModule):
         with static max_new_tokens/temperature/top_k for the compiled path.
         """
         prompt = jnp.asarray(prompt, jnp.int32)
+        # post-fit params are host numpy (trainer re-hydration); numpy
+        # leaves cannot be indexed by tracers inside the decode scan
+        params = jax.tree.map(jnp.asarray, params)
         b, s0 = prompt.shape
         total = s0 + max_new_tokens
         if total > self.cfg.max_seq_len:
