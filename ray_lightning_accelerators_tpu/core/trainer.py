@@ -68,6 +68,7 @@ class Trainer:
                  precision: Any = "bf16",
                  accumulate_grad_batches: int = 1,
                  gradient_clip_val: Optional[float] = None,
+                 log_grad_norm: bool = False,
                  enable_checkpointing: bool = True,
                  checkpoint_format: str = "pickle",
                  num_sanity_val_steps: int = 0,
@@ -96,6 +97,10 @@ class Trainer:
         self.compute_dtype = _PRECISION_DTYPES[precision]
         self.accumulate_grad_batches = max(1, accumulate_grad_batches)
         self.gradient_clip_val = gradient_clip_val
+        # adds a "grad_norm" metric computed inside the jitted step (one
+        # fused reduction, no host sync -- the XLA-honest way to watch for
+        # divergence/clipping pressure)
+        self.log_grad_norm = log_grad_norm
         self.enable_checkpointing = enable_checkpointing
         # "pickle": single-file, rank-0 host gather (reference-shaped).
         # "sharded": every process writes its own shards (orbax; scales to
@@ -231,6 +236,8 @@ class Trainer:
 
             (_, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(st.params)
+            if self.log_grad_norm:
+                metrics["grad_norm"] = optax.global_norm(grads)
             updates, new_opt = tx.update(grads, st.opt_state, st.params)
             new_params = optax.apply_updates(st.params, updates)
             new_state = st.replace(step=st.step + 1, params=new_params,

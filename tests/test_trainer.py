@@ -126,3 +126,13 @@ def test_seed_env_propagation(tmpdir):
     get_trainer(tmpdir, RayTPUAccelerator(1), callbacks=[])
     assert os.environ.get("PL_GLOBAL_SEED") == "0"
     assert os.environ.get("RLA_TPU_GLOBAL_SEED") == "0"
+
+
+def test_log_grad_norm_metric():
+    from tests.utils import BoringModel, boring_loaders
+    train, val = boring_loaders()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      log_grad_norm=True, enable_checkpointing=False,
+                      default_root_dir="/tmp/gn_test")
+    trainer.fit(BoringModel(), train, val)
+    assert trainer.callback_metrics.get("grad_norm", 0.0) > 0.0
