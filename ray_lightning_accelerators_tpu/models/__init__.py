@@ -4,6 +4,7 @@
   examples/ray_ddp_example.py:18-59).
 - ``resnet``      -- CIFAR-10 ResNet-18 (BASELINE config #3).
 - ``transformer`` -- flagship GPT for the parallelism stack.
+- ``vit``         -- Vision Transformer (attention-based vision family).
 
 Re-exports are lazy (PEP 562) so importing one family does not pay for the
 others (the transformer pulls in the whole parallelism stack).
@@ -15,6 +16,7 @@ _EXPORTS = {
     "ResNet18": "resnet", "CIFAR10DataModule": "resnet",
     "synthetic_cifar10": "resnet",
     "GPT": "transformer", "TransformerConfig": "transformer",
+    "ViT": "vit", "ViTConfig": "vit",
 }
 
 __all__ = sorted(_EXPORTS)
