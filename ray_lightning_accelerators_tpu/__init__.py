@@ -19,8 +19,10 @@ from .data.loader import (ArrayDataset, DataLoader, Dataset, RandomDataset,
 from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.session import get_actor_rank, init_session, put_queue
 from .utils.profiler import Profiler, device_memory_stats
+from . import models  # lazy family exports (models/__init__.py PEP 562)
 from . import tune
 from .tune import TuneReportCallback, TuneReportCheckpointCallback
+from .utils import schedules
 
 __version__ = "0.1.0"
 
@@ -34,5 +36,6 @@ __all__ = [
     "MeshConfig", "build_mesh",
     "get_actor_rank", "init_session", "put_queue",
     "Profiler", "device_memory_stats",
+    "models", "schedules",
     "tune", "TuneReportCallback", "TuneReportCheckpointCallback",
 ]

@@ -44,6 +44,11 @@ class TransformerConfig:
     dropout: float = 0.0          # (kept 0 in bench; rng plumbed for parity)
     causal: bool = True
     remat: bool = False           # jax.checkpoint each layer
+    # what the rematerialized backward may keep: "nothing" recomputes the
+    # whole layer (min HBM), "dots" saves matmul outputs (recompute only
+    # elementwise — the usual sweet spot: matmuls are the expensive part
+    # to redo on the MXU, activations are the expensive part to hold in HBM)
+    remat_policy: str = "nothing"
     pipeline_microbatches: int = 4  # GPipe schedule when mesh has pipeline>1
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
@@ -78,6 +83,21 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     rx1 = x1 * cos - x2 * sin
     rx2 = x2 * cos + x1 * sin
     return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _remat_policy(name: str):
+    """Map a config string to a jax.checkpoint policy."""
+    policies = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "everything": jax.checkpoint_policies.everything_saveable,
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat_policy {name!r}; choose from "
+                         f"{sorted(policies)}")
+    return policies[name]
 
 
 class GPT(TpuModule):
@@ -256,7 +276,8 @@ class GPT(TpuModule):
                 return self._block(carry, layer_params, pos)
 
             if self.cfg.remat:
-                block = jax.checkpoint(block)
+                block = jax.checkpoint(block, policy=_remat_policy(
+                    self.cfg.remat_policy))
             out, aux_per_layer = jax.lax.scan(block, h_in, layers)
             return out, jnp.sum(aux_per_layer)
 

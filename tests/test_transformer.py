@@ -88,3 +88,36 @@ def test_gpt_remat_matches(tmpdir):
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_remat_policies_match_no_remat():
+    import pytest as _pytest
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 32)), jnp.int32)
+    losses = {}
+    for remat, policy in ((False, "nothing"), (True, "nothing"),
+                          (True, "dots")):
+        cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=2,
+                                d_ff=128, n_layers=2, max_seq_len=32,
+                                remat=remat, remat_policy=policy)
+        m = GPT(cfg)
+        p = m.init_params(jax.random.PRNGKey(0))
+        loss, _ = jax.jit(lambda pp, mm=m: mm.training_step(
+            pp, toks, jax.random.PRNGKey(1)))(p)
+        g = jax.jit(jax.grad(lambda pp, mm=m: mm.training_step(
+            pp, toks, jax.random.PRNGKey(1))[0]))(p)
+        losses[(remat, policy)] = (float(loss), g)
+    base_loss, base_g = losses[(False, "nothing")]
+    for key, (loss, g) in losses.items():
+        assert loss == _pytest.approx(base_loss, rel=1e-5), key
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(base_g)):
+            np.testing.assert_allclose(a, b, atol=2e-5)
+    with _pytest.raises(ValueError, match="remat_policy"):
+        GPT(TransformerConfig(vocab_size=64, d_model=64, n_heads=2,
+                              d_ff=128, n_layers=1, max_seq_len=32,
+                              remat=True, remat_policy="bogus")
+            ).training_step(
+                GPT(TransformerConfig(vocab_size=64, d_model=64, n_heads=2,
+                                      d_ff=128, n_layers=1, max_seq_len=32)
+                    ).init_params(jax.random.PRNGKey(0)),
+                toks, jax.random.PRNGKey(0))
