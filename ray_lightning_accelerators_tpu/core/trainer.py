@@ -64,6 +64,7 @@ class Trainer:
                  limit_train_batches: Optional[int] = None,
                  limit_val_batches: Optional[int] = None,
                  check_val_every_n_epoch: int = 1,
+                 val_check_interval: Optional[int] = None,
                  log_every_n_steps: int = 50,
                  precision: Any = "bf16",
                  accumulate_grad_batches: int = 1,
@@ -88,6 +89,10 @@ class Trainer:
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches
         self.check_val_every_n_epoch = max(1, check_val_every_n_epoch)
+        # mid-epoch validation every N optimizer steps (long-epoch/LM runs
+        # where an epoch is too coarse a cadence); epoch-boundary validation
+        # still runs per check_val_every_n_epoch
+        self.val_check_interval = val_check_interval
         self.log_every_n_steps = log_every_n_steps
         self.precision = precision
         if precision not in _PRECISION_DTYPES:
@@ -483,6 +488,10 @@ class Trainer:
                 if self.global_step % self.log_every_n_steps == 0:
                     self._log_now({f"{k}": float(v) for k, v in
                                    jax.device_get(train_metrics).items()})
+                if (self.val_check_interval
+                        and self._val_loader is not None
+                        and self.global_step % self.val_check_interval == 0):
+                    self._mid_epoch_validation(module)
                 if self.max_steps and self.global_step >= self.max_steps:
                     self.should_stop = True
                     break
@@ -544,6 +553,23 @@ class Trainer:
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
         self.fit_duration_s = time.perf_counter() - t0
+
+    def _mid_epoch_validation(self, module) -> None:
+        """Validation pass at a step boundary (val_check_interval); fires
+        the same callbacks as epoch-boundary validation so checkpointing /
+        early stopping / Tune reporting see mid-epoch metrics."""
+        for c in self.callbacks:
+            c.on_validation_start(self, module)
+        with self._span("validation"):
+            val_metrics = self._run_eval(self._val_loader,
+                                         self._eval_step_fn,
+                                         limit=self.limit_val_batches,
+                                         prefix=None)
+        self.callback_metrics.update(val_metrics)
+        self._log_now(val_metrics)
+        module.on_validation_epoch_end()
+        for c in self.callbacks:
+            c.on_validation_end(self, module)
 
     def _span(self, name: str):
         """Profiler span, or a null context when no profiler is attached

@@ -136,3 +136,20 @@ def test_log_grad_norm_metric():
                       default_root_dir="/tmp/gn_test")
     trainer.fit(BoringModel(), train, val)
     assert trainer.callback_metrics.get("grad_norm", 0.0) > 0.0
+
+
+def test_val_check_interval_mid_epoch():
+    from tests.utils import BoringModel, boring_loaders
+    train, val = boring_loaders()  # 64 samples / batch 8 = 8 steps/epoch
+
+    class CountingModel(BoringModel):
+        pass
+
+    model = CountingModel()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      val_check_interval=2, enable_checkpointing=False,
+                      default_root_dir="/tmp/vci_test")
+    trainer.fit(model, train, val)
+    # 4 mid-epoch validations (steps 2,4,6,8) + 1 epoch-boundary validation
+    assert model.val_epoch == 5
+    assert "val_loss" in trainer.callback_metrics
