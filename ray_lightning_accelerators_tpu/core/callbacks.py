@@ -15,6 +15,7 @@ import os
 from typing import Any, Dict, Optional
 
 from ..utils.logging import log
+from ..utils.sharded_checkpoint import remove_checkpoint
 
 
 class Callback:
@@ -104,12 +105,6 @@ class EarlyStopping(Callback):
         self.stopped_epoch = state.get("stopped_epoch")
 
 
-def _remove_checkpoint(path: str) -> None:
-    """Evict a checkpoint: a pickle file or a sharded directory."""
-    from ..utils.sharded_checkpoint import remove_checkpoint
-    remove_checkpoint(path)
-
-
 class ModelCheckpoint(Callback):
     """Save checkpoints, tracking the best by `monitor`.
 
@@ -155,7 +150,7 @@ class ModelCheckpoint(Callback):
                 self._saved.append((0.0, self.best_model_path))
                 while len(self._saved) > max(0, self.save_top_k - 1):
                     _, evicted = self._saved.pop(0)
-                    _remove_checkpoint(evicted)
+                    remove_checkpoint(evicted)
             self.best_model_path = path
             return
         current = trainer.callback_metrics.get(self.monitor)
@@ -173,7 +168,7 @@ class ModelCheckpoint(Callback):
             while len(self._saved) > self.save_top_k:
                 _, evicted = self._saved.pop()
                 if evicted != path:
-                    _remove_checkpoint(evicted)
+                    remove_checkpoint(evicted)
             if self._is_better(current, self.best_model_score):
                 self.best_model_score = current
                 self.best_model_path = path

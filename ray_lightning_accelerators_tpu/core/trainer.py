@@ -249,7 +249,10 @@ class Trainer:
                                    opt_state=new_opt)
             sched = getattr(module, "lr_schedule", None)
             if callable(sched):  # evaluated in-trace; no host sync
-                metrics["lr"] = sched(st.step)
+                # MultiSteps advances the inner schedule once per
+                # accumulation window, so index by optimizer updates,
+                # not micro-steps
+                metrics["lr"] = sched(st.step // self.accumulate_grad_batches)
             return new_state, metrics
 
         def eval_step(params, batch):
@@ -492,6 +495,7 @@ class Trainer:
                         and self._val_loader is not None
                         and self.global_step % self.val_check_interval == 0):
                     self._mid_epoch_validation(module)
+                    self._last_val_step = self.global_step
                 if self.max_steps and self.global_step >= self.max_steps:
                     self.should_stop = True
                     break
@@ -513,6 +517,11 @@ class Trainer:
 
             run_val = (self._val_loader is not None and
                        (self.current_epoch + 1) % self.check_val_every_n_epoch == 0)
+            if run_val and getattr(self, "_last_val_step", -1) == self.global_step:
+                # a val_check_interval pass just ran at this exact step;
+                # don't validate the same params twice (double-counts
+                # EarlyStopping patience and ModelCheckpoint saves)
+                run_val = False
             if run_val:
                 for c in self.callbacks:
                     c.on_validation_start(self, module)

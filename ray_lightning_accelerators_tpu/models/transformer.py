@@ -112,7 +112,15 @@ class GPT(TpuModule):
         super().__init__()
         if config is None:
             config = TransformerConfig(**cfg_overrides)
+        elif isinstance(config, dict):
+            # hparams round-trip: load_from_checkpoint calls cls(**hparams)
+            # with the asdict()-serialized config
+            config = TransformerConfig(**config)
         self.cfg = config
+        if isinstance(lr, str):
+            # a schedule was checkpointed as its repr (not reconstructable);
+            # resume optimization at the default rate unless overridden
+            lr = 3e-4
         self.lr = lr
         if callable(lr):
             self.lr_schedule = lr
@@ -479,22 +487,29 @@ class GPT(TpuModule):
                              f"max_seq_len ({self.cfg.max_seq_len})")
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        h_last, cache = self._prefill(params, prompt, total)
-        dt = self.compute_dtype
-        logits0 = (h_last @ self._unembed(params).astype(dt)
-                   ).astype(jnp.float32)
-        rng, r0 = jax.random.split(rng)
-        tok0 = self._sample(logits0, temperature, top_k, r0)
+        # decode replicated: a training-time sequence/tensor/pipeline mesh
+        # must not carve up generation-step-sized activations (the prompt
+        # length need not divide those axes)
+        mesh_saved, self.mesh = self.mesh, None
+        try:
+            h_last, cache = self._prefill(params, prompt, total)
+            dt = self.compute_dtype
+            logits0 = (h_last @ self._unembed(params).astype(dt)
+                       ).astype(jnp.float32)
+            rng, r0 = jax.random.split(rng)
+            tok0 = self._sample(logits0, temperature, top_k, r0)
 
-        def step(carry, i):
-            cache, tok, rng = carry
-            logits, cache = self._decode_token(params, cache, tok, s0 + i)
-            rng, r = jax.random.split(rng)
-            nxt = self._sample(logits, temperature, top_k, r)
-            return (cache, nxt, rng), nxt
+            def step(carry, i):
+                cache, tok, rng = carry
+                logits, cache = self._decode_token(params, cache, tok, s0 + i)
+                rng, r = jax.random.split(rng)
+                nxt = self._sample(logits, temperature, top_k, r)
+                return (cache, nxt, rng), nxt
 
-        (_, _, _), toks = jax.lax.scan(
-            step, (cache, tok0, rng), jnp.arange(max_new_tokens - 1))
-        out = jnp.concatenate(
-            [prompt, tok0[:, None], toks.transpose(1, 0)], axis=1)
-        return out
+            (_, _, _), toks = jax.lax.scan(
+                step, (cache, tok0, rng), jnp.arange(max_new_tokens - 1))
+            out = jnp.concatenate(
+                [prompt, tok0[:, None], toks.transpose(1, 0)], axis=1)
+            return out
+        finally:
+            self.mesh = mesh_saved

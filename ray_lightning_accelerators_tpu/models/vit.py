@@ -71,7 +71,13 @@ class ViT(TpuModule):
         super().__init__()
         if config is None:
             config = ViTConfig(**cfg_overrides)
+        elif isinstance(config, dict):
+            # hparams round-trip: load_from_checkpoint calls cls(**hparams)
+            config = ViTConfig(**config)
         self.cfg = config
+        if isinstance(lr, str):
+            # a schedule was checkpointed as its repr; fall back to default
+            lr = 1e-3
         self.lr = lr
         if callable(lr):
             self.lr_schedule = lr

@@ -36,6 +36,7 @@ META_FILE = "meta.json"
 
 _sync_ckptr = None
 _async_ckptr = None
+_finalize_threads: list = []
 
 
 def _checkpointer(async_save: bool):
@@ -52,9 +53,12 @@ def _checkpointer(async_save: bool):
 
 
 def wait_until_finished() -> None:
-    """Fence any in-flight async save (no-op when none)."""
+    """Fence any in-flight async save: the orbax commit AND the meta.json
+    finalize rename (no-op when none in flight)."""
     if _async_ckptr is not None:
         _async_ckptr.wait_until_finished()
+    while _finalize_threads:
+        _finalize_threads.pop().join()
 
 
 def is_sharded_checkpoint(path: str) -> bool:
@@ -82,16 +86,21 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
         with open(tmp, "w") as f:
             json.dump(metadata, f)
         if async_save:
-            # rename only once the array commit completes; the async
-            # checkpointer exposes that as a finalize callback-free wait,
-            # so fence here cheaply via a deferred rename thread
+            # rename only once the array commit completes, from a tracked
+            # (joinable) thread: wait_until_finished() joins it, so a fenced
+            # checkpoint is guaranteed to carry its completion marker
             import threading
 
             def _finalize():
                 _async_ckptr.wait_until_finished()
-                os.replace(tmp, os.path.join(path, META_FILE))
+                try:
+                    os.replace(tmp, os.path.join(path, META_FILE))
+                except OSError:
+                    pass  # checkpoint dir evicted while committing
 
-            threading.Thread(target=_finalize, daemon=True).start()
+            t = threading.Thread(target=_finalize, daemon=True)
+            _finalize_threads.append(t)
+            t.start()
         else:
             os.replace(tmp, os.path.join(path, META_FILE))
 

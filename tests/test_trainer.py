@@ -150,6 +150,16 @@ def test_val_check_interval_mid_epoch():
                       val_check_interval=2, enable_checkpointing=False,
                       default_root_dir="/tmp/vci_test")
     trainer.fit(model, train, val)
-    # 4 mid-epoch validations (steps 2,4,6,8) + 1 epoch-boundary validation
-    assert model.val_epoch == 5
+    # 4 mid-epoch validations (steps 2,4,6,8); the epoch-boundary pass is
+    # suppressed because step 8 already validated these exact params
+    assert model.val_epoch == 4
     assert "val_loss" in trainer.callback_metrics
+
+    # interval NOT aligned with epoch end: mid-epoch passes at steps 3,6
+    # plus the epoch-boundary pass
+    model2 = CountingModel()
+    trainer2 = Trainer(max_epochs=1, precision="f32", seed=0,
+                       val_check_interval=3, enable_checkpointing=False,
+                       default_root_dir="/tmp/vci_test2")
+    trainer2.fit(model2, train, val)
+    assert model2.val_epoch == 3

@@ -121,3 +121,42 @@ def test_remat_policies_match_no_remat():
                                       d_ff=128, n_layers=1, max_seq_len=32)
                     ).init_params(jax.random.PRNGKey(0)),
                 toks, jax.random.PRNGKey(0))
+
+
+def test_gpt_checkpoint_hparams_roundtrip(tmp_path):
+    """load_from_checkpoint must rebuild GPT from dict-serialized config
+    and tolerate a schedule lr stored as its repr."""
+    from ray_lightning_accelerators_tpu import (DataLoader as DL, Trainer,
+                                                ModelCheckpoint)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.utils import schedules
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, d_ff=64,
+                            n_layers=1, max_seq_len=16)
+    model = GPT(cfg, lr=schedules.warmup_cosine(1e-3, 10, 2))
+    toks = np.random.default_rng(0).integers(
+        0, 64, size=(16, 16)).astype(np.int32)
+    cb = ModelCheckpoint(monitor=None)
+    tr = Trainer(max_epochs=1, precision="f32", seed=0, callbacks=[cb],
+                 default_root_dir=str(tmp_path))
+    tr.fit(model, DL(ArrayDataset(toks), batch_size=8))
+    loaded = GPT.load_from_checkpoint(cb.best_model_path)
+    assert isinstance(loaded.cfg, TransformerConfig)
+    assert loaded.cfg.d_model == 32
+    assert not callable(loaded.lr) or loaded.lr_schedule is None
+    for a, b in zip(jax.tree.leaves(loaded.params),
+                    jax.tree.leaves(model.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_generate_clears_training_mesh():
+    """generate() after a sequence-parallel fit must not shard decode."""
+    from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, d_ff=64,
+                            n_layers=1, max_seq_len=32)
+    m = GPT(cfg)
+    m.mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=2, sequence=4))
+    params = m.init_params(jax.random.PRNGKey(0))
+    out = m.generate(params, jnp.ones((1, 5), jnp.int32), max_new_tokens=4)
+    assert out.shape == (1, 9)
+    assert m.mesh is not None  # restored afterwards
