@@ -50,8 +50,6 @@ def train_gpt(num_epochs=10, num_workers=None, use_fsdp=False, tensor=1,
 
     prompt = tok.encode("the pod ")
     import numpy as np
-    model.mesh = None  # decode replicated: seq dims are generation-step
-    # sized and must not be carved up by a training-time sequence axis
     out = model.generate(model.params, np.asarray([prompt], np.int32),
                          max_new_tokens=48)
     print("sample:", repr(tok.decode(list(map(int, out[0])))))

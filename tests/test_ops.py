@@ -10,6 +10,16 @@ from ray_lightning_accelerators_tpu.ops.attention import (
     attention_reference, flash_attention, flash_attention_interpret)
 
 
+# CPU runs both paths in strict f32; on real TPU the MXU's default matmul
+# precision (bf16-grade passes) plus the online-softmax accumulation order
+# shifts values by up to ~1e-2 absolute on O(1) outputs
+_ON_CPU = jax.default_backend() == "cpu"
+_TOL = (dict(atol=2e-5, rtol=2e-5) if _ON_CPU
+        else dict(atol=2e-2, rtol=5e-2))
+_GRAD_TOL = (dict(atol=1e-4, rtol=1e-4) if _ON_CPU
+             else dict(atol=5e-2, rtol=1e-1))
+
+
 def _qkv(b=2, h=2, s=256, d=64, seed=0, dtype=jnp.float32):
     rng = jax.random.PRNGKey(seed)
     kq, kk, kv = jax.random.split(rng, 3)
@@ -25,8 +35,7 @@ def test_flash_matches_reference(causal):
     ref = attention_reference(q, k, v, causal=causal)
     out = flash_attention_interpret(q, k, v, causal=causal,
                                     block_q=128, block_k=128)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **_TOL)
 
 
 def test_flash_uneven_blocks():
@@ -34,8 +43,7 @@ def test_flash_uneven_blocks():
     ref = attention_reference(q, k, v, causal=True)
     out = flash_attention_interpret(q, k, v, causal=True,
                                     block_q=128, block_k=128)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **_TOL)
 
 
 def test_flash_gradients_match():
@@ -51,7 +59,7 @@ def test_flash_gradients_match():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   atol=1e-4, rtol=1e-4)
+                                   **_GRAD_TOL)
 
 
 def test_cpu_dispatch_falls_back():
