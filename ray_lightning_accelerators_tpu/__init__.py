@@ -14,8 +14,8 @@ from .core.module import TpuModule
 from .core.state import TrainState
 from .core.trainer import Trainer
 from .data.datamodule import DataModule
-from .data.loader import (ArrayDataset, DataLoader, Dataset, RandomDataset,
-                          ShardedSampler)
+from .data.loader import (ArrayDataset, DataLoader, Dataset,
+                          IterableDataset, RandomDataset, ShardedSampler)
 from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.session import get_actor_rank, init_session, put_queue
 from .utils.profiler import Profiler, device_memory_stats
@@ -31,8 +31,8 @@ __all__ = [
     "HorovodRayAccelerator",
     "Trainer", "TpuModule", "TrainState",
     "Callback", "EarlyStopping", "ModelCheckpoint",
-    "DataModule", "DataLoader", "Dataset", "ArrayDataset", "RandomDataset",
-    "ShardedSampler",
+    "DataModule", "DataLoader", "Dataset", "IterableDataset", "ArrayDataset",
+    "RandomDataset", "ShardedSampler",
     "MeshConfig", "build_mesh",
     "get_actor_rank", "init_session", "put_queue",
     "Profiler", "device_memory_stats",

@@ -12,11 +12,12 @@ the device-resident cache fast path, core/trainer.py).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, \
+    Sequence
 
 import numpy as np
 
-from .loader import ArrayDataset
+from .loader import ArrayDataset, IterableDataset
 
 
 class CharTokenizer:
@@ -88,6 +89,47 @@ def lm_dataset(text: str, seq_len: int,
         raise ValueError(
             f"corpus too small for even one row of seq_len={seq_len}")
     return ArrayDataset(packed), tokenizer
+
+
+def pack_stream(docs: Iterable[Sequence[int]], seq_len: int,
+                eos_id: Optional[int] = CharTokenizer.EOS_ID
+                ) -> Iterator[np.ndarray]:
+    """Streaming packer: yields [seq_len] int32 rows as documents arrive,
+    holding only one partial row in memory (the trailing remainder is
+    dropped, as in pack_sequences(drop_remainder=True))."""
+    buf: List[int] = []
+    for d in docs:
+        buf.extend(int(t) for t in d)
+        if eos_id is not None:
+            buf.append(eos_id)
+        while len(buf) >= seq_len:
+            yield np.asarray(buf[:seq_len], np.int32)
+            del buf[:seq_len]
+
+
+class StreamingLMDataset(IterableDataset):
+    """Pack an unbounded document stream into fixed rows on the fly.
+
+    ``doc_factory`` is called once per epoch (with the epoch number) and
+    must return an iterable of token sequences — e.g. a generator reading
+    shards off disk.  Memory stays O(seq_len) regardless of corpus size;
+    multi-process sharding happens row-wise in the DataLoader.
+    """
+
+    def __init__(self, doc_factory: Callable[[int], Iterable[Sequence[int]]],
+                 seq_len: int,
+                 eos_id: Optional[int] = CharTokenizer.EOS_ID):
+        self.doc_factory = doc_factory
+        self.seq_len = seq_len
+        self.eos_id = eos_id
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        return pack_stream(self.doc_factory(self._epoch), self.seq_len,
+                           self.eos_id)
 
 
 def synthetic_corpus(n_sentences: int = 200, seed: int = 0) -> str:

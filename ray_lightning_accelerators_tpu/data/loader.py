@@ -32,6 +32,23 @@ class Dataset:
         raise NotImplementedError
 
 
+class IterableDataset:
+    """Stream-style dataset: yields examples, no len/random access.
+
+    For corpora that don't fit in memory (the LM pretraining case —
+    data/lm.py's StreamingLMDataset packs a document stream on the fly).
+    Sharding under multi-process is element-wise round-robin: process p of
+    P keeps elements where ``index % P == p`` — every process sees a
+    disjoint, interleaved slice of one deterministic stream, the streaming
+    analog of ShardedSampler's disjoint index shards.
+
+    Optional hook: ``set_epoch(epoch)`` for epoch-varying streams.
+    """
+
+    def __iter__(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+
 class RandomDataset(Dataset):
     """Fixed random-tensor dataset (fixture parity with the reference's
     RandomDataset, reference: ray_lightning/tests/utils.py:12-21)."""
@@ -143,15 +160,30 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.seed = seed
         self.use_native = use_native
+        self._iterable = isinstance(dataset, IterableDataset)
         self._user_set_sampler = sampler is not None
-        self.sampler = sampler or ShardedSampler(
-            len(dataset), 1, 0, shuffle=shuffle, drop_last=drop_last, seed=seed)
+        if self._iterable:
+            if shuffle:
+                raise ValueError(
+                    "shuffle=True is undefined for an IterableDataset; "
+                    "shuffle in the stream itself")
+            if sampler is not None:
+                raise ValueError("IterableDataset takes no sampler")
+            self.sampler = None
+            self._shard = (1, 0)  # (num_replicas, rank) round-robin
+        else:
+            self.sampler = sampler or ShardedSampler(
+                len(dataset), 1, 0, shuffle=shuffle, drop_last=drop_last,
+                seed=seed)
         self._engine = None  # lazily-built native.DataEngine
         self._engine_key = None
         self._engine_busy = False
 
     def _inject_sampler(self, num_replicas: int, rank: int,
                         shuffle: bool) -> None:
+        if self._iterable:
+            self._shard = (num_replicas, rank)
+            return
         if self._user_set_sampler:
             return
         self.sampler = ShardedSampler(
@@ -159,14 +191,36 @@ class DataLoader:
             drop_last=self.drop_last, seed=self.seed)
 
     def set_epoch(self, epoch: int) -> None:
+        if self._iterable:
+            if hasattr(self.dataset, "set_epoch"):
+                self.dataset.set_epoch(epoch)
+            return
         self.sampler.set_epoch(epoch)
 
     def __len__(self) -> int:
+        if self._iterable:
+            raise TypeError("an IterableDataset loader has no length")
         n = len(self.sampler)
         return n // self.batch_size if self.drop_last else math.ceil(
             n / self.batch_size)
 
+    def _iter_stream(self) -> Iterator[Any]:
+        replicas, rank = self._shard
+        buf = []
+        for i, example in enumerate(self.dataset):
+            if i % replicas != rank:
+                continue
+            buf.append(example)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self.collate_fn(buf)
+
     def __iter__(self) -> Iterator[Any]:
+        if self._iterable:
+            yield from self._iter_stream()
+            return
         engine = self._native_engine()
         if engine is not None:
             # single-consumer engine: while this generator is live, further

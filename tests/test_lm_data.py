@@ -6,6 +6,7 @@ import pytest
 from ray_lightning_accelerators_tpu.data.lm import (CharTokenizer,
                                                     lm_dataset,
                                                     pack_sequences,
+                                                    pack_stream,
                                                     synthetic_corpus)
 
 
@@ -54,3 +55,63 @@ def test_example_smoke():
     trainer = ex.train_gpt(num_epochs=1, batch_size=8, seq_len=64,
                            smoke=True)
     assert trainer.callback_metrics["loss"] > 0
+
+
+def test_pack_stream_matches_batch_packer():
+    docs = [[10, 11, 12], [20, 21], [30, 31, 32, 33]]
+    rows = list(pack_stream(iter(docs), seq_len=4))
+    ref = pack_sequences(docs, seq_len=4)
+    np.testing.assert_array_equal(np.stack(rows), ref)
+
+
+def test_streaming_dataset_trains():
+    import jax
+    from ray_lightning_accelerators_tpu import DataLoader, Trainer
+    from ray_lightning_accelerators_tpu.data.lm import StreamingLMDataset
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+
+    def doc_factory(epoch):
+        rng = np.random.default_rng(epoch)
+        for _ in range(40):
+            yield rng.integers(2, 60, size=rng.integers(5, 30)).tolist()
+
+    ds = StreamingLMDataset(doc_factory, seq_len=32)
+    loader = DataLoader(ds, batch_size=8)
+    with pytest.raises(TypeError, match="no length"):
+        len(loader)
+    model = GPT(TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                  d_ff=64, n_layers=1, max_seq_len=32))
+    trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir="/tmp/stream_lm_test")
+    trainer.fit(model, loader)
+    assert trainer.global_step > 0
+    assert trainer.callback_metrics["loss"] > 0
+
+
+def test_streaming_shard_round_robin():
+    from ray_lightning_accelerators_tpu import DataLoader
+    from ray_lightning_accelerators_tpu.data.lm import StreamingLMDataset
+
+    def doc_factory(epoch):
+        return iter([[i] * 8 for i in range(16)])
+
+    rows_by_rank = {}
+    for rank in (0, 1):
+        ds = StreamingLMDataset(doc_factory, seq_len=8, eos_id=None)
+        loader = DataLoader(ds, batch_size=2)
+        loader._inject_sampler(num_replicas=2, rank=rank, shuffle=False)
+        rows_by_rank[rank] = np.concatenate(list(loader))
+    seen0 = set(rows_by_rank[0][:, 0].tolist())
+    seen1 = set(rows_by_rank[1][:, 0].tolist())
+    assert seen0 & seen1 == set()          # disjoint
+    assert seen0 | seen1 == set(range(16))  # complete
+
+
+def test_iterable_rejects_shuffle_and_sampler():
+    from ray_lightning_accelerators_tpu import DataLoader
+    from ray_lightning_accelerators_tpu.data.lm import StreamingLMDataset
+    ds = StreamingLMDataset(lambda e: iter([]), seq_len=8)
+    with pytest.raises(ValueError, match="shuffle"):
+        DataLoader(ds, batch_size=2, shuffle=True)
