@@ -70,6 +70,8 @@ class Trainer:
                  accumulate_grad_batches: int = 1,
                  gradient_clip_val: Optional[float] = None,
                  log_grad_norm: bool = False,
+                 ema_decay: Optional[float] = None,
+                 ema_eval: bool = False,
                  enable_checkpointing: bool = True,
                  checkpoint_format: str = "pickle",
                  num_sanity_val_steps: int = 0,
@@ -106,6 +108,13 @@ class Trainer:
         # fused reduction, no host sync -- the XLA-honest way to watch for
         # divergence/clipping pressure)
         self.log_grad_norm = log_grad_norm
+        # EMA of params, tracked inside the jitted step as optimizer state
+        # (utils/ema.py); ema_eval runs validation/test on the averaged
+        # weights (the deployment weights) instead of the raw ones
+        self.ema_decay = ema_decay
+        self.ema_eval = ema_eval
+        if ema_eval and ema_decay is None:
+            raise ValueError("ema_eval=True requires ema_decay")
         self.enable_checkpointing = enable_checkpointing
         # "pickle": single-file, rank-0 host gather (reference-shaped).
         # "sharded": every process writes its own shards (orbax; scales to
@@ -212,6 +221,11 @@ class Trainer:
         if self.gradient_clip_val:
             tx = optax.chain(
                 optax.clip_by_global_norm(self.gradient_clip_val), tx)
+        if self.ema_decay:
+            from ..utils.ema import ema_tracker
+            # inside MultiSteps so the shadow moves once per optimizer
+            # update, not per accumulation micro-step
+            tx = optax.chain(tx, ema_tracker(self.ema_decay))
         if self.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(tx, self.accumulate_grad_batches)
         return tx
@@ -631,9 +645,21 @@ class Trainer:
     # ------------------------------------------------------------------ #
     # eval loops                                                         #
     # ------------------------------------------------------------------ #
+    def ema_params(self):
+        """The EMA parameter pytree (device arrays), or None when
+        ema_decay is not set."""
+        from ..utils.ema import ema_params as _extract
+        if self._state is None:
+            return None
+        return _extract(self._state.opt_state)
+
     def _run_eval(self, loader, step_fn, limit=None,
                   prefix: Optional[str] = None) -> Dict[str, float]:
         params = self._state.params
+        if self.ema_eval:
+            averaged = self.ema_params()
+            if averaged is not None:
+                params = averaged
         sums: Dict[str, float] = {}
         weights = 0.0
         device_metrics = []

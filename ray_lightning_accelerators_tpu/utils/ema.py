@@ -1,0 +1,83 @@
+"""Exponential moving average of parameters, as an optax transform.
+
+No reference analog (optimization there is the user's torch code).  The
+TPU-honest design constraint: EMA must update **inside the jitted train
+step** — a callback copying params at epoch boundaries would miss the
+per-step averaging that gives EMA its value, and doing it host-side would
+sync every step.  So the tracker is a ``GradientTransformation`` chained
+AFTER the optimizer: it passes updates through unchanged and shadows the
+post-update parameters in its own state, which lives in the donated
+``TrainState.opt_state`` on device like any optimizer moment (and is
+checkpointed/sharded with it for free).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class EmaState(NamedTuple):
+    ema: Any          # pytree shadowing params (initialized to params)
+    count: jax.Array  # steps taken
+
+
+def ema_tracker(decay: float = 0.999) -> optax.GradientTransformation:
+    """Chain after an optimizer: ``optax.chain(tx, ema_tracker(0.999))``.
+
+    Updates flow through untouched; the state tracks
+    ``ema = decay * ema + (1-decay) * new_params`` each step.  Initializing
+    the shadow to the initial params (rather than zeros + bias correction)
+    keeps extraction a plain state read.
+    """
+
+    def init_fn(params):
+        # a REAL copy: jnp.asarray would alias the param buffers, and the
+        # trainer donates the whole TrainState — donating the same buffer
+        # via params and via this shadow is an XLA error
+        return EmaState(ema=jax.tree.map(jnp.copy, params),
+                        count=jnp.zeros((), jnp.int32))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError(
+                "ema_tracker needs params; call tx.update(grads, state, "
+                "params) with the params argument")
+        new_params = optax.apply_updates(params, updates)
+        d = jnp.asarray(decay, jnp.float32)
+        new_ema = jax.tree.map(
+            lambda e, p: (d * e.astype(jnp.float32)
+                          + (1.0 - d) * p.astype(jnp.float32)).astype(e.dtype),
+            state.ema, new_params)
+        return updates, EmaState(ema=new_ema, count=state.count + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _find_ema_states(opt_state) -> list:
+    """Locate EmaState nodes anywhere in a (possibly nested/wrapped)
+    optimizer state tree — chain tuples, MultiSteps wrappers, etc."""
+    found = []
+
+    def walk(node):
+        if isinstance(node, EmaState):
+            found.append(node)
+            return
+        if isinstance(node, (tuple, list)) or hasattr(node, "_fields"):
+            for child in node:
+                walk(child)
+        elif hasattr(node, "inner_opt_state"):
+            walk(node.inner_opt_state)
+
+    walk(opt_state)
+    return found
+
+
+def ema_params(opt_state):
+    """Extract the EMA parameter pytree from an optimizer state containing
+    an ``ema_tracker``; None when no tracker is present."""
+    states = _find_ema_states(opt_state)
+    return states[0].ema if states else None

@@ -1,0 +1,101 @@
+"""Parameter EMA: transform math, extraction through wrappers, trainer
+integration (incl. FSDP sharding of the shadow)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.utils.ema import (ema_params,
+                                                      ema_tracker)
+from tests.utils import BoringModel, boring_loaders
+
+
+def test_tracker_math():
+    params = {"w": jnp.ones((4,))}
+    tx = optax.chain(optax.sgd(0.5), ema_tracker(decay=0.5))
+    state = tx.init(params)
+    grads = {"w": jnp.ones((4,))}
+    updates, state = tx.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    # sgd: w 1.0 -> 0.5; ema: 0.5*1.0 + 0.5*0.5 = 0.75
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.5)
+    np.testing.assert_allclose(np.asarray(ema_params(state)["w"]), 0.75)
+    # and updates flowed through unchanged by the tracker
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.5)
+
+
+def test_extraction_through_multisteps():
+    params = {"w": jnp.ones((2,))}
+    tx = optax.MultiSteps(optax.chain(optax.sgd(0.1), ema_tracker(0.9)), 2)
+    state = tx.init(params)
+    assert ema_params(state) is not None
+    # accumulation micro-step must NOT advance the shadow
+    g = {"w": jnp.ones((2,))}
+    _, state = tx.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(ema_params(state)["w"]), 1.0)
+    # window commit advances it
+    _, state = tx.update(g, state, params)
+    assert float(np.asarray(ema_params(state)["w"])[0]) < 1.0
+
+
+def test_no_tracker_returns_none():
+    params = {"w": jnp.ones((2,))}
+    tx = optax.adam(1e-3)
+    assert ema_params(tx.init(params)) is None
+
+
+def test_trainer_ema_eval_uses_averaged_weights():
+    train, val = boring_loaders()
+    model = BoringModel()
+    trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+                      ema_decay=0.98, ema_eval=True,
+                      enable_checkpointing=False,
+                      default_root_dir="/tmp/ema_test")
+    trainer.fit(model, train, val)
+    avg = trainer.ema_params()
+    assert avg is not None
+    raw = trainer._state.params
+    # shadow lags the raw weights (they moved every step)
+    diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+             for a, b in zip(jax.tree.leaves(avg), jax.tree.leaves(raw))]
+    assert max(diffs) > 0
+
+
+def test_ema_eval_requires_decay():
+    with pytest.raises(ValueError, match="ema_decay"):
+        Trainer(ema_eval=True)
+
+
+def test_ema_state_sharded_under_fsdp():
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+
+    class WideModel(BoringModel):
+        def init_params(self, rng):
+            k = jax.random.normal(rng, (32, 128), jnp.float32) * 0.1
+            return {"layer": {"kernel": k,
+                              "bias": jnp.zeros((128,), jnp.float32)}}
+
+        def forward(self, params, x):
+            return x @ params["layer"]["kernel"] + params["layer"]["bias"]
+
+        def validation_step(self, params, batch):
+            return {"val_loss": jnp.mean(self.forward(params, batch) ** 2)}
+
+        def training_step(self, params, batch, rng):
+            loss = jnp.mean((self.forward(params, batch) - 1.0) ** 2)
+            return loss, {"loss": loss}
+
+    model = WideModel()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      accelerator=RayTPUAccelerator(use_fsdp=True),
+                      ema_decay=0.99, enable_checkpointing=False,
+                      default_root_dir="/tmp/ema_fsdp_test")
+    trainer.fit(model, DataLoader(ArrayDataset(x), batch_size=8))
+    avg = trainer.ema_params()
+    kernel = avg["layer"]["kernel"]
+    # the shadow inherited the param's FSDP sharding (not replicated)
+    assert not kernel.sharding.is_fully_replicated
