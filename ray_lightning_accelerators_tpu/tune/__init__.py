@@ -10,13 +10,15 @@ from .run import (ExperimentAnalysis, Trial, checkpoint_payload,
                   is_session_enabled, report, run, trial_should_stop)
 from .schedulers import (ASHAScheduler, FIFOScheduler, MedianStoppingRule,
                          TrialScheduler)
-from .search import (choice, grid_search, loguniform, randint, uniform)
+from .search import (TPESearcher, choice, grid_search, loguniform, randint,
+                     uniform)
 
 __all__ = [
     "run", "report", "checkpoint_payload", "is_session_enabled",
     "trial_should_stop",
     "ExperimentAnalysis", "Trial",
     "choice", "uniform", "loguniform", "randint", "grid_search",
+    "TPESearcher",
     "TuneReportCallback", "TuneReportCheckpointCallback",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
 ]

@@ -190,6 +190,7 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         raise_on_failed_trial: bool = True,
         verbose: int = 0,
         scheduler=None,
+        search_alg=None,
         **_compat_kwargs) -> ExperimentAnalysis:
     """Run `trainable(config)` for every sampled/grid config.
 
@@ -207,11 +208,18 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
 
     if scheduler is not None:
         scheduler.set_search_properties(metric, mode)
-
-    configs = generate_trial_configs(config, num_samples, seed)
+    if search_alg is not None:
+        # model-based sequential search: each config is suggested from the
+        # history of completed trials instead of sampled up front
+        search_alg.set_search_properties(metric, mode)
+        configs = [None] * num_samples
+    else:
+        configs = generate_trial_configs(config, num_samples, seed)
     trials = []
     global _trial_session
     for i, cfg in enumerate(configs):
+        if search_alg is not None:
+            cfg = search_alg.suggest(dict(config or {}))
         trial = Trial(f"trial_{i:05d}", cfg, exp_dir)
         trials.append(trial)
         q = TrampolineQueue()
@@ -232,6 +240,9 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         finally:
             session_lib.shutdown_session()
             _trial_session = None
+        if search_alg is not None and metric is not None and \
+                trial.last_result.get(metric) is not None:
+            search_alg.record(cfg, float(trial.last_result[metric]))
         if verbose:
             log.warning("trial %s finished: %s", trial.trial_id,
                         trial.last_result)

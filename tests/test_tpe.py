@@ -1,0 +1,72 @@
+"""TPE searcher: convergence on known optima, categorical handling,
+tune.run integration."""
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import tune
+from ray_lightning_accelerators_tpu.tune.search import TPESearcher
+
+
+def test_tpe_concentrates_on_optimum():
+    """Minimize (x-0.3)^2 over uniform(0,1): post-startup suggestions must
+    concentrate near 0.3 and beat the startup phase."""
+    searcher = TPESearcher(n_startup=8, seed=0)
+    searcher.set_search_properties("loss", "min")
+    spec = {"x": tune.uniform(0.0, 1.0)}
+    xs = []
+    for _ in range(40):
+        cfg = searcher.suggest(spec)
+        searcher.record(cfg, (cfg["x"] - 0.3) ** 2)
+        xs.append(cfg["x"])
+    startup_err = np.mean(np.abs(np.asarray(xs[:8]) - 0.3))
+    late_err = np.mean(np.abs(np.asarray(xs[-10:]) - 0.3))
+    assert late_err < startup_err
+    assert late_err < 0.12
+    best = min((cfg_x - 0.3) ** 2 for cfg_x in xs)
+    assert best < 1e-3
+
+
+def test_tpe_loguniform_and_randint():
+    """Optimum at lr=1e-2, width=7; both dims must converge."""
+    searcher = TPESearcher(n_startup=8, seed=1)
+    searcher.set_search_properties("loss", "min")
+    spec = {"lr": tune.loguniform(1e-4, 1.0), "width": tune.randint(1, 16)}
+    for _ in range(50):
+        cfg = searcher.suggest(spec)
+        loss = (np.log10(cfg["lr"]) + 2) ** 2 + 0.1 * (cfg["width"] - 7) ** 2
+        searcher.record(cfg, loss)
+    hist = searcher._history
+    best_cfg = min(hist, key=lambda t: t[1])[0]
+    assert 1e-3 < best_cfg["lr"] < 1e-1
+    assert 4 <= best_cfg["width"] <= 10
+    assert isinstance(best_cfg["width"], int)
+
+
+def test_tpe_categorical_prefers_good_choice():
+    searcher = TPESearcher(n_startup=6, seed=2)
+    searcher.set_search_properties("score", "max")
+    spec = {"opt": tune.choice(["a", "b", "c"])}
+    for _ in range(40):
+        cfg = searcher.suggest(spec)
+        searcher.record(cfg, {"a": 0.1, "b": 1.0, "c": 0.2}[cfg["opt"]])
+    late = [searcher.suggest(spec)["opt"] for _ in range(20)]
+    assert late.count("b") > 10
+
+
+def test_tpe_static_values_pass_through():
+    searcher = TPESearcher(n_startup=2, seed=0)
+    cfg = searcher.suggest({"x": tune.uniform(0, 1), "epochs": 5})
+    assert cfg["epochs"] == 5
+
+
+def test_tune_run_with_search_alg(tmp_path):
+    def trainable(config):
+        tune.report(loss=(config["x"] - 0.7) ** 2)
+
+    analysis = tune.run(trainable, config={"x": tune.uniform(0.0, 1.0)},
+                        num_samples=25, metric="loss", mode="min",
+                        search_alg=TPESearcher(n_startup=6, seed=0),
+                        local_dir=str(tmp_path))
+    assert abs(analysis.best_config["x"] - 0.7) < 0.15
+    assert analysis.best_result["loss"] < 0.02
