@@ -1,0 +1,138 @@
+"""Torch weight interop: import/export between torch state_dicts and
+param pytrees.
+
+The migration path for reference users: their models and checkpoints are
+torch (the reference is a PTL plugin; its whole world is
+``state_dict()``s, reference: ray_lightning/ray_ddp.py:274).  This module
+moves weights across, with the two convention mismatches handled
+explicitly:
+
+- **Linear layout**: ``torch.nn.Linear.weight`` is [out, in]; the matmul
+  convention throughout this framework is [in, out] — transpose on the way
+  through.
+- **dtypes**: torch bf16 has no numpy dtype; conversions route through
+  ``ml_dtypes.bfloat16`` (shipped with jax) without an f32 detour.
+
+The mapping API is explicit (pytree path -> state_dict key + optional
+transform): silent name-fuzzy matching is how weight imports go quietly
+wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+Transform = Callable[[np.ndarray], np.ndarray]
+MapEntry = Union[str, Tuple[str, Transform]]
+
+
+def from_torch(tensor) -> np.ndarray:
+    """torch.Tensor -> numpy, preserving bf16 via ml_dtypes."""
+    import torch
+    t = tensor.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def to_torch(array):
+    """numpy/jax array -> torch.Tensor, preserving bf16."""
+    import torch
+    a = np.asarray(array)
+    if a.dtype.name == "bfloat16":
+        return torch.from_numpy(
+            a.view(np.uint16).copy()).view(torch.bfloat16)
+    return torch.from_numpy(a.copy())
+
+
+def transpose(a: np.ndarray) -> np.ndarray:
+    """The Linear-layout transform ([out, in] -> [in, out])."""
+    return np.ascontiguousarray(a.T)
+
+
+def state_dict_to_tree(state_dict) -> Dict[str, np.ndarray]:
+    """Whole torch state_dict -> flat {key: numpy} dict."""
+    return {k: from_torch(v) for k, v in state_dict.items()}
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _set_path(tree: Dict, path: str, value) -> None:
+    keys = path.split("/")
+    node = tree
+    for k in keys[:-1]:
+        node = node[k]
+    node[keys[-1]] = value
+
+
+def import_state_dict(template_params: Dict, state_dict,
+                      mapping: Dict[str, MapEntry],
+                      strict: bool = True) -> Dict:
+    """Build a params pytree from a torch ``state_dict``.
+
+    ``mapping``: pytree path (``"dense_0/kernel"``) -> state_dict key, or
+    ``(key, transform)`` — e.g. ``("net.0.weight", transpose)`` for Linear
+    kernels.  Every mapped array is shape-checked against the template;
+    with ``strict`` every template leaf must be mapped.
+    """
+    import copy
+
+    flat = _flatten(template_params)
+    missing = sorted(set(flat) - set(mapping))
+    if strict and missing:
+        raise ValueError(f"unmapped template leaves: {missing}")
+    extra = sorted(set(mapping) - set(flat))
+    if extra:
+        raise ValueError(f"mapping paths not in template: {extra}")
+
+    out = copy.deepcopy({k: v for k, v in template_params.items()})
+    for path, entry in mapping.items():
+        key, tf = entry if isinstance(entry, tuple) else (entry, None)
+        if key not in state_dict:
+            raise KeyError(f"{key!r} not in state_dict (for {path!r})")
+        arr = from_torch(state_dict[key])
+        if tf is not None:
+            arr = tf(arr)
+        want = np.shape(flat[path])
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"{path!r}: state_dict {key!r} has shape {arr.shape}, "
+                f"template wants {want} (missing a transpose?)")
+        arr = arr.astype(np.asarray(flat[path]).dtype)
+        _set_path(out, path, arr)
+    return out
+
+
+def linear_mapping(tree_path: str, torch_prefix: str) -> Dict[str, MapEntry]:
+    """Mapping entries for one torch ``nn.Linear`` -> {kernel, bias} pair."""
+    return {
+        f"{tree_path}/kernel": (f"{torch_prefix}.weight", transpose),
+        f"{tree_path}/bias": f"{torch_prefix}.bias",
+    }
+
+
+def export_state_dict(params: Dict,
+                      mapping: Dict[str, MapEntry]) -> Dict[str, Any]:
+    """Inverse of import_state_dict: params pytree -> torch state_dict
+    (same mapping; transforms are re-applied, so involutions like
+    ``transpose`` round-trip)."""
+    flat = _flatten(params)
+    out = {}
+    for path, entry in mapping.items():
+        key, tf = entry if isinstance(entry, tuple) else (entry, None)
+        arr = np.asarray(flat[path])
+        if tf is not None:
+            arr = tf(arr)
+        out[key] = to_torch(arr)
+    return out

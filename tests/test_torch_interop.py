@@ -1,0 +1,93 @@
+"""Torch weight interop: dtype round-trips, Linear import, forward
+equivalence torch vs jax, export round-trip."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_accelerators_tpu.models.mnist import MNISTClassifier
+from ray_lightning_accelerators_tpu.utils import torch_interop as ti
+
+
+def test_dtype_roundtrips():
+    for dtype in (torch.float32, torch.bfloat16, torch.int32):
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3).to(dtype)
+        back = ti.to_torch(ti.from_torch(t))
+        assert back.dtype == dtype
+        assert torch.equal(back, t)
+
+
+def test_jax_bf16_to_torch():
+    a = jnp.asarray([[1.5, -2.25]], jnp.bfloat16)
+    t = ti.to_torch(a)
+    assert t.dtype == torch.bfloat16
+    np.testing.assert_allclose(t.float().numpy(), [[1.5, -2.25]])
+
+
+class _TorchMLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(784, 128), torch.nn.ReLU(),
+            torch.nn.Linear(128, 256), torch.nn.ReLU(),
+            torch.nn.Linear(256, 10))
+
+    def forward(self, x):
+        return self.net(x)
+
+
+def _mapping():
+    m = {}
+    for i, layer in enumerate((0, 2, 4)):
+        m.update(ti.linear_mapping(f"dense_{i}", f"net.{layer}"))
+    return m
+
+
+def test_forward_equivalence():
+    torch.manual_seed(0)
+    tm = _TorchMLP().eval()
+    model = MNISTClassifier({"layer_1": 128, "layer_2": 256})
+    template = model.init_params(jax.random.PRNGKey(0))
+    params = ti.import_state_dict(template, tm.state_dict(), _mapping())
+    x = np.random.default_rng(0).normal(size=(4, 784)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    out = np.asarray(model.forward(params, x))
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_shape_mismatch_caught():
+    tm = _TorchMLP()
+    model = MNISTClassifier({"layer_1": 128, "layer_2": 256})
+    template = model.init_params(jax.random.PRNGKey(0))
+    bad = dict(_mapping())
+    bad["dense_0/kernel"] = "net.0.weight"  # missing transpose
+    with pytest.raises(ValueError, match="transpose"):
+        ti.import_state_dict(template, tm.state_dict(), bad)
+
+
+def test_strict_requires_full_mapping():
+    tm = _TorchMLP()
+    model = MNISTClassifier({"layer_1": 128, "layer_2": 256})
+    template = model.init_params(jax.random.PRNGKey(0))
+    partial = ti.linear_mapping("dense_0", "net.0")
+    with pytest.raises(ValueError, match="unmapped"):
+        ti.import_state_dict(template, tm.state_dict(), partial)
+    out = ti.import_state_dict(template, tm.state_dict(), partial,
+                               strict=False)
+    # unmapped leaves keep template values
+    np.testing.assert_array_equal(np.asarray(out["dense_2"]["kernel"]),
+                                  np.asarray(template["dense_2"]["kernel"]))
+
+
+def test_export_roundtrip():
+    tm = _TorchMLP()
+    model = MNISTClassifier({"layer_1": 128, "layer_2": 256})
+    template = model.init_params(jax.random.PRNGKey(0))
+    params = ti.import_state_dict(template, tm.state_dict(), _mapping())
+    sd = ti.export_state_dict(params, _mapping())
+    for k, v in sd.items():
+        assert torch.allclose(v, tm.state_dict()[k], atol=1e-6), k
