@@ -231,9 +231,9 @@ class GPT(TpuModule):
         dt = self.compute_dtype
         a = layer_params["attn"]
         x = self._rms_norm(h, layer_params["ln1"])
-        q = jnp.einsum("bsd,dhk->bhsk", x, a["wq"].astype(dt))
-        k = jnp.einsum("bsd,dhk->bhsk", x, a["wk"].astype(dt))
-        v = jnp.einsum("bsd,dhk->bhsk", x, a["wv"].astype(dt))
+        q = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         q = self._constrain(q, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
@@ -243,10 +243,10 @@ class GPT(TpuModule):
         v = self._constrain(v, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
                             mesh_lib.SEQUENCE_AXIS, None)
         attn = self._attention(q, k, v)
-        h = h + jnp.einsum("bhsk,hkd->bsd", attn, a["wo"].astype(dt))
+        h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
 
         x = self._rms_norm(h, layer_params["ln2"])
-        m = layer_params["mlp"]
+        m = self._dequant_tree(layer_params["mlp"], dt)
         if cfg.num_experts > 1:
             y, aux = moe_mlp(x, m, top_k=cfg.moe_top_k,
                              capacity_factor=cfg.moe_capacity_factor,
@@ -254,11 +254,11 @@ class GPT(TpuModule):
             h = h + y
         else:
             aux = jnp.zeros((), jnp.float32)
-            up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"].astype(dt)))
+            up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"]))
             up = self._constrain(up, mesh_lib.BATCH_AXES,
                                  mesh_lib.SEQUENCE_AXIS,
                                  mesh_lib.TENSOR_AXIS)
-            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
+            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"])
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)
         if return_kv:
@@ -271,7 +271,7 @@ class GPT(TpuModule):
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
         dt = self.compute_dtype
-        h = params["embed"].astype(dt)[tokens]
+        h = self._wt(params["embed"], dt)[tokens]
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)
 
@@ -305,8 +305,7 @@ class GPT(TpuModule):
         h = self._rms_norm(h, params["ln_f"])
         if return_hidden:
             return h, aux
-        logits = jnp.einsum("bsd,dv->bsv", h,
-                            self._unembed(params).astype(dt))
+        logits = jnp.einsum("bsd,dv->bsv", h, self._unembed_w(params, dt))
         logits = logits.astype(jnp.float32)
         return (logits, aux) if return_aux else logits
 
@@ -371,6 +370,69 @@ class GPT(TpuModule):
         return optax.adamw(self.lr, weight_decay=0.01)
 
     # ------------------------------------------------------------------ #
+    # Weight-only int8 quantization (inference)                          #
+    # ------------------------------------------------------------------ #
+    # Decode is HBM-bandwidth-bound: every generated token re-reads every
+    # weight.  Symmetric per-out-channel int8 halves the bytes per read vs
+    # bf16; dequant happens in-registers and XLA fuses it into the matmul.
+    # Quantized trees are for generate()/predict paths only (training
+    # keeps full precision).
+
+    @staticmethod
+    def quantize_weights(params):
+        """Return a params tree where matmul weights become
+        {"q8": int8, "scale": f32} with per-out-channel symmetric scales.
+
+        Structure-aware: leaves under ``layers`` are layer-STACKED
+        ([L, ...]), so their scales keep the leading layer axis (the layer
+        scan unstacks q8 and scale together) and only ndim>=3 leaves
+        quantize (the [L, d] norm scales stay dense).  Top-level
+        embed/unembed quantize at ndim>=2; 1D norms stay dense.
+        """
+        def quant(arr, keep_first: bool):
+            arr = jnp.asarray(arr)
+            min_ndim = 3 if keep_first else 2
+            if arr.ndim < min_ndim or \
+                    not jnp.issubdtype(arr.dtype, jnp.floating):
+                return arr
+            axes = tuple(range(1 if keep_first else 0, arr.ndim - 1))
+            amax = jnp.max(jnp.abs(arr.astype(jnp.float32)),
+                           axis=axes, keepdims=True)
+            scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+            q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale),
+                         -127, 127).astype(jnp.int8)
+            return {"q8": q, "scale": scale.astype(jnp.float32)}
+
+        out = {k: v for k, v in params.items()}
+        out["layers"] = jax.tree.map(lambda a: quant(a, True),
+                                     params["layers"])
+        out["embed"] = quant(params["embed"], False)
+        if "unembed" in params:
+            out["unembed"] = quant(params["unembed"], False)
+        return out
+
+    @staticmethod
+    def _is_q8(w) -> bool:
+        return isinstance(w, dict) and "q8" in w
+
+    def _wt(self, w, dt):
+        """Weight fetch: dequantize an int8 leaf or cast a dense one."""
+        if self._is_q8(w):
+            return (w["q8"].astype(jnp.float32) * w["scale"]).astype(dt)
+        return w.astype(dt)
+
+    def _dequant_tree(self, tree, dt):
+        """Fetch every weight in a subtree (the MLP/MoE block params)."""
+        return jax.tree.map(lambda w: self._wt(w, dt), tree,
+                            is_leaf=self._is_q8)
+
+    def _unembed_w(self, params, dt) -> jax.Array:
+        """Dequant-aware unembedding matrix [d, V]."""
+        if self.cfg.tie_embeddings:
+            return self._wt(params["embed"], dt).T
+        return self._wt(params["unembed"], dt)
+
+    # ------------------------------------------------------------------ #
     # Autoregressive generation (KV cache)                               #
     # ------------------------------------------------------------------ #
     # TPU-first decode: everything is static-shaped — the cache is
@@ -384,7 +446,7 @@ class GPT(TpuModule):
         """Run the prompt once; returns (last-position hidden [B,d],
         cache dict with k/v [L,B,H,total_len,D])."""
         dt = self.compute_dtype
-        h = params["embed"].astype(dt)[tokens]
+        h = self._wt(params["embed"], dt)[tokens]
         pos = jnp.arange(tokens.shape[1])
 
         def block(carry, lp):
@@ -409,9 +471,9 @@ class GPT(TpuModule):
         a = lp["attn"]
         x = self._rms_norm(h, lp["ln1"])
         positions = pos[None]  # [1]
-        q = jnp.einsum("bsd,dhk->bhsk", x, a["wq"].astype(dt))
-        k = jnp.einsum("bsd,dhk->bhsk", x, a["wk"].astype(dt))
-        v = jnp.einsum("bsd,dhk->bhsk", x, a["wv"].astype(dt))
+        q = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
@@ -426,25 +488,24 @@ class GPT(TpuModule):
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bhqk,bhkd->bhqd", p, cv.astype(jnp.float32)
                           ).astype(dt)
-        h = h + jnp.einsum("bhsk,hkd->bsd", attn, a["wo"].astype(dt))
+        h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
         x = self._rms_norm(h, lp["ln2"])
-        m = lp["mlp"]
+        m = self._dequant_tree(lp["mlp"], dt)
         if cfg.num_experts > 1:
             y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
                            capacity_factor=cfg.moe_capacity_factor,
                            compute_dtype=dt, mesh=self.mesh)
             h = h + y
         else:
-            up = jax.nn.gelu(
-                jnp.einsum("bsd,df->bsf", x, m["wi"].astype(dt)))
-            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"].astype(dt))
+            up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"]))
+            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"])
         return h, ck, cv
 
     def _decode_token(self, params, cache, token, pos):
         """Full-depth single-token step.  token: [B] int32.  Returns
         (logits [B,V] f32, updated cache)."""
         dt = self.compute_dtype
-        h = params["embed"].astype(dt)[token][:, None]  # [B,1,d]
+        h = self._wt(params["embed"], dt)[token][:, None]  # [B,1,d]
 
         def layer(carry, xs):
             h_in = carry
@@ -455,7 +516,7 @@ class GPT(TpuModule):
         h, (cks, cvs) = jax.lax.scan(
             layer, h, (params["layers"], cache["k"], cache["v"]))
         h = self._rms_norm(h, params["ln_f"])
-        logits = (h[:, 0] @ self._unembed(params).astype(dt)
+        logits = (h[:, 0] @ self._unembed_w(params, dt)
                   ).astype(jnp.float32)
         return logits, {"k": cks, "v": cvs}
 
@@ -494,7 +555,7 @@ class GPT(TpuModule):
         try:
             h_last, cache = self._prefill(params, prompt, total)
             dt = self.compute_dtype
-            logits0 = (h_last @ self._unembed(params).astype(dt)
+            logits0 = (h_last @ self._unembed_w(params, dt)
                        ).astype(jnp.float32)
             rng, r0 = jax.random.split(rng)
             tok0 = self._sample(logits0, temperature, top_k, r0)

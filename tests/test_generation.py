@@ -91,3 +91,42 @@ def test_moe_decode_matches_naive():
     out = model.generate(params, prompt, max_new_tokens=6)
     ref = _naive_generate(model, params, prompt, 6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_int8_quantized_generate():
+    """Weight-only int8 decode: quantization error bound per leaf, logits
+    close to full precision, and the cached decode still matches a naive
+    quantized re-forward exactly."""
+    model, params = _model()
+    qparams = model.quantize_weights(params)
+    # per-channel symmetric error bound: |w - dq| <= scale/2
+    flat = {"q": qparams["layers"]["attn"]["wq"],
+            "orig": params["layers"]["attn"]["wq"]}
+    dq = flat["q"]["q8"].astype(np.float32) * flat["q"]["scale"]
+    err = np.abs(np.asarray(dq) - np.asarray(flat["orig"]))
+    bound = np.asarray(flat["q"]["scale"]) / 2 + 1e-7
+    assert (err <= bound).all()
+    # 1D leaves stay dense
+    assert not isinstance(qparams["ln_f"], dict)
+
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, 97, size=(2, 8)), jnp.int32)
+    logits_full = model.forward(params, prompt)
+    logits_q = model.forward(qparams, prompt)
+    # int8 logits track full precision closely
+    np.testing.assert_allclose(np.asarray(logits_q),
+                               np.asarray(logits_full), atol=0.35)
+    out = model.generate(qparams, prompt, max_new_tokens=8)
+    ref = _naive_generate(model, qparams, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert out.shape == (2, 16)
+
+
+def test_quantized_tree_is_half_the_bytes():
+    model, params = _model()
+    qparams = model.quantize_weights(params)
+
+    def nbytes(t):
+        return sum(np.asarray(x).nbytes for x in jax.tree.leaves(t))
+
+    assert nbytes(qparams) < 0.5 * nbytes(params)
