@@ -111,6 +111,9 @@ class Trainer:
         # EMA of params, tracked inside the jitted step as optimizer state
         # (utils/ema.py); ema_eval runs validation/test on the averaged
         # weights (the deployment weights) instead of the raw ones
+        if ema_decay is not None and not (0.0 < ema_decay < 1.0):
+            raise ValueError(
+                f"ema_decay must be in (0, 1), got {ema_decay}")
         self.ema_decay = ema_decay
         self.ema_eval = ema_eval
         if ema_eval and ema_decay is None:
@@ -221,7 +224,7 @@ class Trainer:
         if self.gradient_clip_val:
             tx = optax.chain(
                 optax.clip_by_global_norm(self.gradient_clip_val), tx)
-        if self.ema_decay:
+        if self.ema_decay is not None:
             from ..utils.ema import ema_tracker
             # inside MultiSteps so the shadow moves once per optimizer
             # update, not per accumulation micro-step

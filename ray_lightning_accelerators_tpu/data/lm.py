@@ -94,17 +94,24 @@ def lm_dataset(text: str, seq_len: int,
 def pack_stream(docs: Iterable[Sequence[int]], seq_len: int,
                 eos_id: Optional[int] = CharTokenizer.EOS_ID
                 ) -> Iterator[np.ndarray]:
-    """Streaming packer: yields [seq_len] int32 rows as documents arrive,
-    holding only one partial row in memory (the trailing remainder is
-    dropped, as in pack_sequences(drop_remainder=True))."""
+    """Streaming packer: yields [seq_len] int32 rows as tokens arrive,
+    holding at most one partial row — O(seq_len) memory even when a single
+    document is itself huge (tokens drain into rows chunk by chunk rather
+    than absorbing the whole document first).  The trailing remainder is
+    dropped, as in pack_sequences(drop_remainder=True)."""
     buf: List[int] = []
+
+    def drain(tokens) -> Iterator[np.ndarray]:
+        for t in tokens:
+            buf.append(int(t))
+            if len(buf) == seq_len:
+                yield np.asarray(buf, np.int32)
+                buf.clear()
+
     for d in docs:
-        buf.extend(int(t) for t in d)
+        yield from drain(d)
         if eos_id is not None:
-            buf.append(eos_id)
-        while len(buf) >= seq_len:
-            yield np.asarray(buf[:seq_len], np.int32)
-            del buf[:seq_len]
+            yield from drain((eos_id,))
 
 
 class StreamingLMDataset(IterableDataset):

@@ -205,16 +205,23 @@ class DataLoader:
             n / self.batch_size)
 
     def _iter_stream(self) -> Iterator[Any]:
+        """Batch boundaries align to global blocks of replicas*batch_size
+        elements, so EVERY rank yields exactly one batch per complete
+        block — per-rank batch counts are equal by construction.  (Naive
+        per-rank batching lets counts diverge on ragged streams, and a
+        rank with one extra step hangs the others' collectives.)  The
+        ragged tail block is dropped under multi-replica sharding for the
+        same reason."""
         replicas, rank = self._shard
+        block = replicas * self.batch_size
         buf = []
         for i, example in enumerate(self.dataset):
-            if i % replicas != rank:
-                continue
-            buf.append(example)
-            if len(buf) == self.batch_size:
+            if i % replicas == rank:
+                buf.append(example)
+            if (i + 1) % block == 0:
                 yield self.collate_fn(buf)
                 buf = []
-        if buf and not self.drop_last:
+        if buf and not self.drop_last and replicas == 1:
             yield self.collate_fn(buf)
 
     def __iter__(self) -> Iterator[Any]:

@@ -246,7 +246,7 @@ class GPT(TpuModule):
         h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
 
         x = self._rms_norm(h, layer_params["ln2"])
-        m = self._dequant_tree(layer_params["mlp"], dt)
+        m = self._dequant_q8_leaves(layer_params["mlp"], dt)
         if cfg.num_experts > 1:
             y, aux = moe_mlp(x, m, top_k=cfg.moe_top_k,
                              capacity_factor=cfg.moe_capacity_factor,
@@ -254,11 +254,12 @@ class GPT(TpuModule):
             h = h + y
         else:
             aux = jnp.zeros((), jnp.float32)
-            up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"]))
+            up = jax.nn.gelu(
+                jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
             up = self._constrain(up, mesh_lib.BATCH_AXES,
                                  mesh_lib.SEQUENCE_AXIS,
                                  mesh_lib.TENSOR_AXIS)
-            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"])
+            h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)
         if return_kv:
@@ -421,10 +422,13 @@ class GPT(TpuModule):
             return (w["q8"].astype(jnp.float32) * w["scale"]).astype(dt)
         return w.astype(dt)
 
-    def _dequant_tree(self, tree, dt):
-        """Fetch every weight in a subtree (the MLP/MoE block params)."""
-        return jax.tree.map(lambda w: self._wt(w, dt), tree,
-                            is_leaf=self._is_q8)
+    def _dequant_q8_leaves(self, tree, dt):
+        """Dequantize ONLY int8 leaves in a subtree; dense leaves pass
+        through untouched so downstream code keeps its own dtype policy
+        (moe_mlp deliberately routes in f32 master precision)."""
+        return jax.tree.map(
+            lambda w: self._wt(w, dt) if self._is_q8(w) else w, tree,
+            is_leaf=self._is_q8)
 
     def _unembed_w(self, params, dt) -> jax.Array:
         """Dequant-aware unembedding matrix [d, V]."""
@@ -490,15 +494,16 @@ class GPT(TpuModule):
                           ).astype(dt)
         h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
         x = self._rms_norm(h, lp["ln2"])
-        m = self._dequant_tree(lp["mlp"], dt)
+        m = self._dequant_q8_leaves(lp["mlp"], dt)
         if cfg.num_experts > 1:
             y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
                            capacity_factor=cfg.moe_capacity_factor,
                            compute_dtype=dt, mesh=self.mesh)
             h = h + y
         else:
-            up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, m["wi"]))
-            h = h + jnp.einsum("bsf,fd->bsd", up, m["wo"])
+            up = jax.nn.gelu(
+                jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
+            h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
         return h, ck, cv
 
     def _decode_token(self, params, cache, token, pos):

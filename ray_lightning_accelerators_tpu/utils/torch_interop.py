@@ -34,7 +34,11 @@ def from_torch(tensor) -> np.ndarray:
     t = tensor.detach().cpu()
     if t.dtype == torch.bfloat16:
         import ml_dtypes
-        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        # Tensor.view(dtype) needs contiguity (transposed/sliced state_dict
+        # entries are not); the f32 path survives because .numpy() handles
+        # strides itself
+        return t.contiguous().view(torch.uint16).numpy().view(
+            ml_dtypes.bfloat16)
     return t.numpy()
 
 

@@ -99,3 +99,9 @@ def test_ema_state_sharded_under_fsdp():
     kernel = avg["layer"]["kernel"]
     # the shadow inherited the param's FSDP sharding (not replicated)
     assert not kernel.sharding.is_fully_replicated
+
+
+def test_degenerate_decay_rejected():
+    for bad in (0.0, 1.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="ema_decay"):
+            Trainer(ema_decay=bad)

@@ -115,3 +115,34 @@ def test_iterable_rejects_shuffle_and_sampler():
     ds = StreamingLMDataset(lambda e: iter([]), seq_len=8)
     with pytest.raises(ValueError, match="shuffle"):
         DataLoader(ds, batch_size=2, shuffle=True)
+
+
+def test_streaming_equal_batches_on_ragged_stream():
+    """11 rows over 2 replicas must give BOTH ranks the same batch count
+    (unequal counts would hang multi-process collectives)."""
+    from ray_lightning_accelerators_tpu import DataLoader
+    from ray_lightning_accelerators_tpu.data.lm import StreamingLMDataset
+
+    def doc_factory(epoch):
+        return iter([[i] * 8 for i in range(11)])
+
+    counts = {}
+    for rank in (0, 1):
+        ds = StreamingLMDataset(doc_factory, seq_len=8, eos_id=None)
+        loader = DataLoader(ds, batch_size=2)
+        loader._inject_sampler(num_replicas=2, rank=rank, shuffle=False)
+        counts[rank] = len(list(loader))
+    assert counts[0] == counts[1] == 2
+
+
+def test_pack_stream_generator_docs_constant_memory():
+    """Documents may be generators (no slicing/len); packing must not
+    require materializing a document."""
+    from ray_lightning_accelerators_tpu.data.lm import pack_stream
+
+    def one_huge_doc():
+        yield (x % 250 + 2 for x in range(10_000))
+
+    rows = list(pack_stream(one_huge_doc(), seq_len=128, eos_id=None))
+    assert len(rows) == 10_000 // 128
+    assert rows[0][0] == 2 and rows[1][0] == (128 % 250) + 2

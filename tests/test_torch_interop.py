@@ -91,3 +91,13 @@ def test_export_roundtrip():
     sd = ti.export_state_dict(params, _mapping())
     for k, v in sd.items():
         assert torch.allclose(v, tm.state_dict()[k], atol=1e-6), k
+
+
+def test_from_torch_noncontiguous_bf16():
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4).to(
+        torch.bfloat16).t()  # transposed = non-contiguous
+    a = ti.from_torch(t)
+    assert a.shape == (4, 3)
+    np.testing.assert_allclose(
+        a.astype(np.float32),
+        t.float().numpy())
