@@ -41,7 +41,7 @@ class TransformerConfig:
     d_ff: int = 2048
     n_layers: int = 8
     max_seq_len: int = 2048
-    dropout: float = 0.0          # (kept 0 in bench; rng plumbed for parity)
+    dropout: float = 0.0          # residual-branch dropout (train only)
     causal: bool = True
     remat: bool = False           # jax.checkpoint each layer
     # what the rematerialized backward may keep: "nothing" recomputes the
@@ -226,7 +226,13 @@ class GPT(TpuModule):
                                           causal=self.cfg.causal)
         return flash_attention(q, k, v, self.cfg.causal)
 
-    def _block(self, h, layer_params, positions, return_kv: bool = False):
+    def _dropout(self, x, rng):
+        p = self.cfg.dropout
+        keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+    def _block(self, h, layer_params, positions, return_kv: bool = False,
+               dropout_rng=None):
         cfg = self.cfg
         dt = self.compute_dtype
         a = layer_params["attn"]
@@ -243,7 +249,11 @@ class GPT(TpuModule):
         v = self._constrain(v, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
                             mesh_lib.SEQUENCE_AXIS, None)
         attn = self._attention(q, k, v)
-        h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
+        attn_out = jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
+        if dropout_rng is not None and cfg.dropout > 0:
+            dropout_rng, r_attn = jax.random.split(dropout_rng)
+            attn_out = self._dropout(attn_out, r_attn)
+        h = h + attn_out
 
         x = self._rms_norm(h, layer_params["ln2"])
         m = self._dequant_q8_leaves(layer_params["mlp"], dt)
@@ -251,7 +261,6 @@ class GPT(TpuModule):
             y, aux = moe_mlp(x, m, top_k=cfg.moe_top_k,
                              capacity_factor=cfg.moe_capacity_factor,
                              compute_dtype=dt, mesh=self.mesh)
-            h = h + y
         else:
             aux = jnp.zeros((), jnp.float32)
             up = jax.nn.gelu(
@@ -259,7 +268,10 @@ class GPT(TpuModule):
             up = self._constrain(up, mesh_lib.BATCH_AXES,
                                  mesh_lib.SEQUENCE_AXIS,
                                  mesh_lib.TENSOR_AXIS)
-            h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
+            y = jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
+        if dropout_rng is not None and cfg.dropout > 0:
+            y = self._dropout(y, dropout_rng)
+        h = h + y
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)
         if return_kv:
@@ -267,10 +279,14 @@ class GPT(TpuModule):
         return h, aux
 
     def forward(self, params, batch, return_aux: bool = False,
-                return_hidden: bool = False):
+                return_hidden: bool = False, dropout_rng=None):
+        """``dropout_rng``: per-step PRNG key enabling dropout (train
+        mode); None (eval/decode) makes the forward deterministic."""
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
+        if dropout_rng is not None and self.cfg.dropout <= 0:
+            dropout_rng = None
         dt = self.compute_dtype
         h = self._wt(params["embed"], dt)[tokens]
         h = self._constrain(h, mesh_lib.BATCH_AXES,
@@ -280,6 +296,22 @@ class GPT(TpuModule):
             # positions derive from the (static) seq length; recomputed here
             # so the pipeline stage body closes over no outer-context tracers
             pos = jnp.arange(h_in.shape[1])
+
+            if dropout_rng is not None:
+                # rng rides the scan carry; each layer folds off its key
+                def block_do(carry, layer_params):
+                    h_c, r = carry
+                    r, sub = jax.random.split(r)
+                    h_new, aux = self._block(h_c, layer_params, pos,
+                                             dropout_rng=sub)
+                    return (h_new, r), aux
+
+                if self.cfg.remat:
+                    block_do = jax.checkpoint(block_do, policy=_remat_policy(
+                        self.cfg.remat_policy))
+                (out, _), aux_per_layer = jax.lax.scan(
+                    block_do, (h_in, dropout_rng), layers)
+                return out, jnp.sum(aux_per_layer)
 
             def block(carry, layer_params):
                 return self._block(carry, layer_params, pos)
@@ -296,6 +328,10 @@ class GPT(TpuModule):
                 raise NotImplementedError(
                     "MoE layers under pipeline parallelism are not supported "
                     "yet; use expert/tensor/data axes (set pipeline=1)")
+            if dropout_rng is not None:
+                raise NotImplementedError(
+                    "dropout under pipeline parallelism is not supported "
+                    "(per-stage rng would correlate masks); set dropout=0")
             from ..parallel.pipeline import pipeline_apply
             h = pipeline_apply(lambda lp, hm: stack(hm, lp)[0],
                                params["layers"], h, self.mesh,
@@ -330,13 +366,14 @@ class GPT(TpuModule):
     # ------------------------------------------------------------------ #
     # Steps                                                              #
     # ------------------------------------------------------------------ #
-    def _lm_loss(self, params, batch):
+    def _lm_loss(self, params, batch, rng=None):
         tokens = batch["input_ids"] if isinstance(batch, dict) else batch
         if isinstance(tokens, (tuple, list)):
             tokens = tokens[0]
         if self._use_fused_loss():
             from ..ops.losses import fused_linear_cross_entropy
-            h, aux = self.forward(params, tokens, return_hidden=True)
+            h, aux = self.forward(params, tokens, return_hidden=True,
+                                  dropout_rng=rng)
             d = h.shape[-1]
             rows = h[:, :-1].reshape(-1, d)
             targets = tokens[:, 1:].reshape(-1).astype(jnp.int32)
@@ -344,7 +381,8 @@ class GPT(TpuModule):
                 rows, self._unembed(params).astype(self.compute_dtype),
                 targets, self.cfg.loss_chunk_rows, mesh=self.mesh)
             return loss, acc, aux
-        logits, aux = self.forward(params, tokens, return_aux=True)
+        logits, aux = self.forward(params, tokens, return_aux=True,
+                                   dropout_rng=rng)
         targets = tokens[:, 1:]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], targets).mean()
@@ -352,7 +390,7 @@ class GPT(TpuModule):
         return loss, acc, aux
 
     def training_step(self, params, batch, rng):
-        loss, acc, aux = self._lm_loss(params, batch)
+        loss, acc, aux = self._lm_loss(params, batch, rng=rng)
         metrics = {"loss": loss, "accuracy": acc}
         if self.cfg.num_experts > 1:
             metrics["moe_aux_loss"] = aux
