@@ -66,11 +66,22 @@ class TransformerConfig:
     # "ring" (KV neighbor exchange) or "ulysses" (head/seq all-to-all;
     # needs n_heads % sequence_axis == 0)
     context_parallel: str = "ring"
+    # grouped-query attention: fewer K/V heads than Q heads shrinks the
+    # decode KV cache (and its HBM traffic) by n_heads/n_kv_heads;
+    # None = multi-head attention (kv heads == query heads)
+    n_kv_heads: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        assert self.n_heads % kv == 0, \
+            f"n_heads ({self.n_heads}) must be divisible by n_kv_heads ({kv})"
+        return kv
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -140,6 +151,8 @@ class GPT(TpuModule):
             return (jax.random.normal(key, shape, jnp.float32)
                     * (fan_in ** -0.5))
 
+        kv = cfg.kv_heads
+
         def layer(key):
             ks = jax.random.split(key, 6)
             if cfg.num_experts > 1:
@@ -152,8 +165,8 @@ class GPT(TpuModule):
             return {
                 "attn": {
                     "wq": dense(ks[0], (d, h, hd), d),
-                    "wk": dense(ks[1], (d, h, hd), d),
-                    "wv": dense(ks[2], (d, h, hd), d),
+                    "wk": dense(ks[1], (d, kv, hd), d),
+                    "wv": dense(ks[2], (d, kv, hd), d),
                     "wo": dense(ks[3], (h, hd, d), d),
                 },
                 "mlp": mlp,
@@ -248,7 +261,13 @@ class GPT(TpuModule):
                             mesh_lib.SEQUENCE_AXIS, None)
         v = self._constrain(v, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
                             mesh_lib.SEQUENCE_AXIS, None)
-        attn = self._attention(q, k, v)
+        groups = cfg.n_heads // cfg.kv_heads
+        if groups > 1:  # GQA: broadcast each KV head over its query group
+            kr = jnp.repeat(k, groups, axis=1)
+            vr = jnp.repeat(v, groups, axis=1)
+        else:
+            kr, vr = k, v
+        attn = self._attention(q, kr, vr)
         attn_out = jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
         if dropout_rng is not None and cfg.dropout > 0:
             dropout_rng, r_attn = jax.random.split(dropout_rng)
@@ -522,14 +541,20 @@ class GPT(TpuModule):
                                           (0, 0, pos, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                           (0, 0, pos, 0))
-        # single-query attention over the cache, masked to written slots
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                       ck.astype(jnp.float32)) * cfg.head_dim ** -0.5
+        # grouped single-query attention over the (unrepeated) KV cache,
+        # masked to written slots; groups=1 is plain MHA
+        b = q.shape[0]
+        kvh = ck.shape[1]
+        groups = cfg.n_heads // kvh
+        qg = q.astype(jnp.float32)[:, :, 0].reshape(
+            b, kvh, groups, cfg.head_dim)
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, ck.astype(jnp.float32)
+                       ) * cfg.head_dim ** -0.5
         mask = jnp.arange(ck.shape[2]) <= pos
         s = jnp.where(mask[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", p, cv.astype(jnp.float32)
-                          ).astype(dt)
+        attn = jnp.einsum("bkgt,bktd->bkgd", p, cv.astype(jnp.float32))
+        attn = attn.reshape(b, cfg.n_heads, 1, cfg.head_dim).astype(dt)
         h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
         x = self._rms_norm(h, lp["ln2"])
         m = self._dequant_q8_leaves(lp["mlp"], dt)

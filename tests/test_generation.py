@@ -130,3 +130,41 @@ def test_quantized_tree_is_half_the_bytes():
         return sum(np.asarray(x).nbytes for x in jax.tree.leaves(t))
 
     assert nbytes(qparams) < 0.5 * nbytes(params)
+
+
+def test_gqa_decode_matches_naive():
+    """Grouped-query attention: cached decode must match full re-forward,
+    and the cache holds only kv_heads (not n_heads)."""
+    cfg = TransformerConfig(vocab_size=97, d_model=64, n_heads=4,
+                            d_ff=128, n_layers=2, max_seq_len=48,
+                            n_kv_heads=2)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    assert params["layers"]["attn"]["wk"].shape == (2, 64, 2, 16)
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 97, size=(2, 8)), jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=10)
+    ref = _naive_generate(model, params, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_gqa_trains_and_quantizes():
+    cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=4, d_ff=128,
+                            n_layers=2, max_seq_len=32, n_kv_heads=1)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    toks = jnp.ones((2, 32), jnp.int32)
+    g = jax.grad(lambda p: model.training_step(
+        p, toks, jax.random.PRNGKey(0))[0])(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(g))
+    qp = model.quantize_weights(params)
+    out = model.generate(qp, jnp.ones((1, 4), jnp.int32), max_new_tokens=4)
+    assert out.shape == (1, 8)
+
+
+def test_gqa_indivisible_heads_rejected():
+    with pytest.raises(AssertionError, match="divisible"):
+        GPT(TransformerConfig(vocab_size=64, d_model=64, n_heads=4,
+                              d_ff=128, n_layers=1, max_seq_len=32,
+                              n_kv_heads=3)).init_params(
+                                  jax.random.PRNGKey(0))
