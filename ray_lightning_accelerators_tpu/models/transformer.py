@@ -589,17 +589,29 @@ class GPT(TpuModule):
         return logits, {"k": cks, "v": cvs}
 
     @staticmethod
-    def _sample(logits, temperature, top_k, rng):
+    def _sample(logits, temperature, top_k, top_p, rng):
         if temperature == 0.0:
             return jnp.argmax(logits, -1).astype(jnp.int32)
         logits = logits / temperature
         if top_k:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p and top_p < 1.0:
+            # nucleus: drop the tail whose cumulative prob exceeds top_p.
+            # sort descending once; a token survives if the cumulative mass
+            # BEFORE it is < top_p (the head token always survives)
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs
+            cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), -1) - 1
+            cutoff = jnp.take_along_axis(sorted_logits,
+                                         cutoff_idx[:, None], axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(rng, logits).astype(jnp.int32)
 
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0,
                  rng: Optional[jax.Array] = None) -> jax.Array:
         """Greedy (temperature=0) or sampled decode.  prompt: [B, S0] int32.
         Returns [B, S0 + max_new_tokens].  Jit-compatible: wrap in jax.jit
@@ -626,13 +638,13 @@ class GPT(TpuModule):
             logits0 = (h_last @ self._unembed_w(params, dt)
                        ).astype(jnp.float32)
             rng, r0 = jax.random.split(rng)
-            tok0 = self._sample(logits0, temperature, top_k, r0)
+            tok0 = self._sample(logits0, temperature, top_k, top_p, r0)
 
             def step(carry, i):
                 cache, tok, rng = carry
                 logits, cache = self._decode_token(params, cache, tok, s0 + i)
                 rng, r = jax.random.split(rng)
-                nxt = self._sample(logits, temperature, top_k, r)
+                nxt = self._sample(logits, temperature, top_k, top_p, r)
                 return (cache, nxt, rng), nxt
 
             (_, _, _), toks = jax.lax.scan(
