@@ -31,10 +31,14 @@ class Accelerator:
     def __init__(self, mesh_config: Optional[mesh_lib.MeshConfig] = None,
                  init_hook: Optional[Callable[[], None]] = None,
                  use_fsdp: bool = False,
-                 dcn_data: int = 1, dcn_pipeline: int = 1):
+                 dcn_data: int = 1, dcn_pipeline: int = 1,
+                 devices: Optional[list] = None):
         self.mesh_config = mesh_config or mesh_lib.MeshConfig()
         self.init_hook = init_hook
         self.use_fsdp = use_fsdp
+        # explicit device subset (e.g. a tune trial's partition,
+        # tune.trial_devices()); None = all visible devices
+        self.devices = list(devices) if devices is not None else None
         # multi-slice: replicate the per-slice (ICI) mesh across slices on
         # the data / pipeline axes over DCN (parallel/mesh.py
         # build_hybrid_mesh); 1 x 1 = single slice
@@ -46,7 +50,8 @@ class Accelerator:
     # Topology                                                          #
     # ---------------------------------------------------------------- #
     def select_devices(self) -> list:
-        devices = list(jax.devices())
+        devices = (list(self.devices) if self.devices is not None
+                   else list(jax.devices()))
         cfg = self.mesh_config
         sizes = (cfg.data, cfg.fsdp, cfg.pipeline, cfg.expert, cfg.sequence,
                  cfg.tensor)

@@ -43,7 +43,8 @@ class RayTPUAccelerator(Accelerator):
                  use_fsdp: bool = False, tensor: int = 1, sequence: int = 1,
                  pipeline: int = 1, expert: int = 1,
                  dcn_data: int = 1, dcn_pipeline: int = 1,
-                 init_hook: Optional[Callable[[], None]] = None):
+                 init_hook: Optional[Callable[[], None]] = None,
+                 devices: Optional[list] = None):
         dp = -1 if num_workers is None else num_workers
         if use_fsdp:
             cfg = mesh_lib.MeshConfig(data=1, fsdp=dp, tensor=tensor,
@@ -54,7 +55,8 @@ class RayTPUAccelerator(Accelerator):
                                       sequence=sequence, pipeline=pipeline,
                                       expert=expert)
         super().__init__(cfg, init_hook=init_hook, use_fsdp=use_fsdp,
-                         dcn_data=dcn_data, dcn_pipeline=dcn_pipeline)
+                         dcn_data=dcn_data, dcn_pipeline=dcn_pipeline,
+                         devices=devices)
         self.num_workers = num_workers
 
     def select_devices(self):

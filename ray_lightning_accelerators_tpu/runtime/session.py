@@ -13,6 +13,7 @@ one); the session is initialized by the trainer/runtime and by tune trials.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Optional
 
 
@@ -34,6 +35,13 @@ class TpuSession:
 
 
 _session: Optional[TpuSession] = None
+# thread-local overlay: concurrent tune trials each bind their own session
+# on their trial + trainable threads without touching the process global
+_tls = threading.local()
+
+
+def _current() -> Optional[TpuSession]:
+    return getattr(_tls, "session", None) or _session
 
 
 def init_session(rank: int, queue: Optional[Any] = None) -> None:
@@ -44,10 +52,17 @@ def init_session(rank: int, queue: Optional[Any] = None) -> None:
     _session = TpuSession(rank, queue)
 
 
+def bind_session_to_thread(session: Optional[TpuSession]) -> None:
+    """Attach (or clear, with None) a session for the CURRENT thread only;
+    shadows the process-global one.  Used by concurrent tune trials."""
+    _tls.session = session
+
+
 def get_session() -> TpuSession:
-    if _session is None:
+    s = _current()
+    if s is None:
         raise ValueError("no session initialized in this process")
-    return _session
+    return s
 
 
 def shutdown_session() -> None:
@@ -56,7 +71,7 @@ def shutdown_session() -> None:
 
 
 def session_exists() -> bool:
-    return _session is not None
+    return _current() is not None
 
 
 def get_actor_rank() -> int:

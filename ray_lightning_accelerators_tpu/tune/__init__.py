@@ -7,7 +7,8 @@ callbacks (the reference's `from ray_lightning.tune import TuneReportCallback`).
 
 from .callbacks import TuneReportCallback, TuneReportCheckpointCallback
 from .run import (ExperimentAnalysis, Trial, checkpoint_payload,
-                  is_session_enabled, report, run, trial_should_stop)
+                  is_session_enabled, report, run, trial_devices,
+                  trial_should_stop)
 from .schedulers import (ASHAScheduler, FIFOScheduler, MedianStoppingRule,
                          TrialScheduler)
 from .search import (TPESearcher, choice, grid_search, loguniform, randint,
@@ -15,7 +16,7 @@ from .search import (TPESearcher, choice, grid_search, loguniform, randint,
 
 __all__ = [
     "run", "report", "checkpoint_payload", "is_session_enabled",
-    "trial_should_stop",
+    "trial_should_stop", "trial_devices",
     "ExperimentAnalysis", "Trial",
     "choice", "uniform", "loguniform", "randint", "grid_search",
     "TPESearcher",

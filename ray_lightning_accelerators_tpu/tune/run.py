@@ -73,39 +73,69 @@ class _TrialSession:
     of a Ray Tune session; probed via is_session_enabled,
     reference: ray_lightning/tune.py:10-22)."""
 
-    def __init__(self, trial: Trial, scheduler=None):
+    def __init__(self, trial: Trial, scheduler=None, devices=None):
         self.trial = trial
         self.scheduler = scheduler
+        self.devices = devices  # this trial's device partition (or None)
         self._lock = threading.Lock()
 
     def report(self, **metrics) -> None:
         with self._lock:
             self.trial.report(metrics)
             if self.scheduler is not None and not self.trial.should_stop:
-                decision = self.scheduler.on_result(self.trial,
-                                                    self.trial.last_result)
+                # schedulers hold cross-trial state (ASHA brackets, median
+                # histories); serialize their decisions across concurrent
+                # trials
+                with _scheduler_lock:
+                    decision = self.scheduler.on_result(
+                        self.trial, self.trial.last_result)
                 if decision == self.scheduler.STOP:
                     self.trial.should_stop = True
 
 
+_scheduler_lock = threading.Lock()
+
+
 _trial_session: Optional[_TrialSession] = None
+# thread-local overlay for concurrent trials (each trial's driver +
+# trainable threads bind their own session; sequential mode keeps using
+# the process-global)
+_tls = threading.local()
+
+
+def _current_session() -> Optional[_TrialSession]:
+    return getattr(_tls, "session", None) or _trial_session
+
+
+def _bind_trial_session(session: Optional[_TrialSession]) -> None:
+    _tls.session = session
 
 
 def is_session_enabled() -> bool:
-    return _trial_session is not None
+    return _current_session() is not None
 
 
 def get_trial_session() -> _TrialSession:
-    if _trial_session is None:
+    s = _current_session()
+    if s is None:
         raise RuntimeError("tune.report()/checkpointing used outside a "
                            "tune.run() trial")
-    return _trial_session
+    return s
 
 
 def trial_should_stop() -> bool:
     """True when the active trial was STOPped by a scheduler; the Tune
     callbacks poll this and end training cleanly via trainer.should_stop."""
-    return _trial_session is not None and _trial_session.trial.should_stop
+    s = _current_session()
+    return s is not None and s.trial.should_stop
+
+
+def trial_devices() -> Optional[list]:
+    """The device partition assigned to the current trial, or None when
+    trials own all devices (sequential mode).  Pass to an accelerator:
+    ``RayTPUAccelerator(devices=tune.trial_devices())``."""
+    s = _current_session()
+    return None if s is None else s.devices
 
 
 def report(**metrics) -> None:
@@ -178,6 +208,50 @@ class ExperimentAnalysis:
         return pd.DataFrame(rows)
 
 
+def _execute_trial(trainable, trial: Trial, scheduler, devices,
+                   raise_on_failed_trial: bool, verbose: int,
+                   set_global: bool) -> None:
+    """Run one trial on the CURRENT thread: bind sessions (thread-local,
+    plus the process-global in sequential mode), fan the trainable out to a
+    worker thread, and drain the trampoline queue until it finishes."""
+    global _trial_session
+    q = TrampolineQueue()
+    tsess = _TrialSession(trial, scheduler, devices=devices)
+    rt = session_lib.TpuSession(0, q)
+    _bind_trial_session(tsess)
+    session_lib.bind_session_to_thread(rt)
+    if set_global:
+        _trial_session = tsess
+        session_lib.init_session(rank=0, queue=q)
+
+    def _bind_worker():  # runs on the pool's worker thread
+        _bind_trial_session(tsess)
+        session_lib.bind_session_to_thread(rt)
+
+    trial.status = "RUNNING"
+    try:
+        with ThreadPoolExecutor(max_workers=1,
+                                initializer=_bind_worker) as pool:
+            fut = pool.submit(trainable, trial.config)
+            process_results([fut], q)
+        trial.status = "STOPPED" if trial.should_stop else "TERMINATED"
+    except BaseException as e:  # noqa: BLE001 - fail-fast like ray.get
+        trial.status = "ERROR"
+        trial.error = e
+        log.warning("trial %s failed: %s", trial.trial_id, e)
+        if raise_on_failed_trial:
+            raise
+    finally:
+        _bind_trial_session(None)
+        session_lib.bind_session_to_thread(None)
+        if set_global:
+            session_lib.shutdown_session()
+            _trial_session = None
+    if verbose:
+        log.warning("trial %s finished: %s", trial.trial_id,
+                    trial.last_result)
+
+
 def run(trainable: Callable[[Dict[str, Any]], Any],
         config: Optional[Dict[str, Any]] = None,
         num_samples: int = 1,
@@ -191,6 +265,8 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         verbose: int = 0,
         scheduler=None,
         search_alg=None,
+        max_concurrent_trials: int = 1,
+        devices_per_trial: Optional[int] = None,
         **_compat_kwargs) -> ExperimentAnalysis:
     """Run `trainable(config)` for every sampled/grid config.
 
@@ -200,6 +276,14 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
     tune.schedulers.TrialScheduler (e.g. ASHAScheduler) consulted on every
     reported result; its STOP decisions end trials early and mark them
     STOPPED.
+
+    ``max_concurrent_trials > 1`` runs trials in parallel over disjoint
+    device partitions — the trials x workers-per-trial parallelism the
+    reference gets from Ray Tune's placement
+    (examples/ray_ddp_example.py:101-113).  Each concurrent trial leases a
+    partition of ``devices_per_trial`` devices (default: an equal split);
+    the trainable claims it via ``tune.trial_devices()``:
+    ``RayTPUAccelerator(devices=tune.trial_devices())``.
     """
     name = name or f"tune_{int(time.time())}"
     local_dir = local_dir or os.path.join(os.getcwd(), "rla_tpu_results")
@@ -209,41 +293,59 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
     if scheduler is not None:
         scheduler.set_search_properties(metric, mode)
     if search_alg is not None:
+        if max_concurrent_trials > 1:
+            raise ValueError(
+                "search_alg suggests each trial from completed-trial "
+                "history and requires sequential trials "
+                "(max_concurrent_trials=1)")
         # model-based sequential search: each config is suggested from the
         # history of completed trials instead of sampled up front
         search_alg.set_search_properties(metric, mode)
         configs = [None] * num_samples
     else:
         configs = generate_trial_configs(config, num_samples, seed)
+
+    if max_concurrent_trials > 1:
+        import queue as queue_mod
+
+        import jax
+        devs = list(jax.devices())
+        per = devices_per_trial or max(1, len(devs) // max_concurrent_trials)
+        n_groups = min(max_concurrent_trials, len(devs) // per)
+        if n_groups < 1:
+            raise ValueError(
+                f"devices_per_trial={per} exceeds the {len(devs)} visible "
+                f"devices")
+        free: "queue_mod.Queue" = queue_mod.Queue()
+        for g in range(n_groups):
+            free.put(devs[g * per:(g + 1) * per])
+        trials = [Trial(f"trial_{i:05d}", cfg, exp_dir)
+                  for i, cfg in enumerate(configs)]
+
+        def _leased(trial):
+            group = free.get()
+            try:
+                _execute_trial(trainable, trial, scheduler, group,
+                               raise_on_failed_trial, verbose,
+                               set_global=False)
+            finally:
+                free.put(group)
+
+        with ThreadPoolExecutor(max_workers=n_groups) as outer:
+            futures = [outer.submit(_leased, t) for t in trials]
+            for f in futures:
+                f.result()  # propagate raise_on_failed_trial errors
+        return ExperimentAnalysis(trials, metric, mode)
+
     trials = []
-    global _trial_session
     for i, cfg in enumerate(configs):
         if search_alg is not None:
             cfg = search_alg.suggest(dict(config or {}))
         trial = Trial(f"trial_{i:05d}", cfg, exp_dir)
         trials.append(trial)
-        q = TrampolineQueue()
-        _trial_session = _TrialSession(trial, scheduler)
-        session_lib.init_session(rank=0, queue=q)
-        trial.status = "RUNNING"
-        try:
-            with ThreadPoolExecutor(max_workers=1) as pool:
-                fut = pool.submit(trainable, cfg)
-                process_results([fut], q)
-            trial.status = "STOPPED" if trial.should_stop else "TERMINATED"
-        except BaseException as e:  # noqa: BLE001 - fail-fast like ray.get
-            trial.status = "ERROR"
-            trial.error = e
-            log.warning("trial %s failed: %s", trial.trial_id, e)
-            if raise_on_failed_trial:
-                raise
-        finally:
-            session_lib.shutdown_session()
-            _trial_session = None
+        _execute_trial(trainable, trial, scheduler, None,
+                       raise_on_failed_trial, verbose, set_global=True)
         if search_alg is not None and metric is not None and \
                 trial.last_result.get(metric) is not None:
             search_alg.record(cfg, float(trial.last_result[metric]))
-        if verbose:
-            log.warning("trial %s finished: %s", trial.trial_id,
-                        trial.last_result)
     return ExperimentAnalysis(trials, metric, mode)

@@ -1,0 +1,100 @@
+"""Concurrent tune trials over disjoint device partitions."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                            RayTPUAccelerator, Trainer, tune)
+from tests.utils import BoringModel
+
+
+def test_parallel_trials_partition_devices(tmp_path):
+    seen = {}
+    lock = threading.Lock()
+    active = {"now": 0, "peak": 0}
+
+    def trainable(config):
+        devices = tune.trial_devices()
+        with lock:
+            active["now"] += 1
+            active["peak"] = max(active["peak"], active["now"])
+        try:
+            assert devices is not None and len(devices) == 4
+            x = np.random.default_rng(0).normal(
+                size=(32, 32)).astype(np.float32)
+            trainer = Trainer(
+                max_epochs=1, precision="f32", seed=0,
+                accelerator=RayTPUAccelerator(devices=devices),
+                enable_checkpointing=False,
+                default_root_dir=str(tmp_path / f"t{config['i']}"))
+            trainer.fit(BoringModel(), DataLoader(ArrayDataset(x),
+                                                  batch_size=8))
+            with lock:
+                seen[config["i"]] = tuple(d.id for d in devices)
+            tune.report(loss=float(config["i"]))
+        finally:
+            with lock:
+                active["now"] -= 1
+
+    analysis = tune.run(trainable,
+                        config={"i": tune.grid_search([0, 1, 2, 3])},
+                        num_samples=1, metric="loss", mode="min",
+                        max_concurrent_trials=2, devices_per_trial=4,
+                        local_dir=str(tmp_path))
+    assert len(analysis.trials) == 4
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    assert analysis.best_result["loss"] == 0.0
+    # two distinct 4-device partitions were used, never overlapping
+    partitions = set(seen.values())
+    assert len(partitions) == 2
+    a, b = partitions
+    assert set(a) & set(b) == set()
+    assert active["peak"] == 2  # trials genuinely overlapped
+
+
+def test_sequential_mode_has_no_partition(tmp_path):
+    def trainable(config):
+        assert tune.trial_devices() is None
+        tune.report(x=1.0)
+
+    analysis = tune.run(trainable, config={}, num_samples=2,
+                        metric="x", mode="max", local_dir=str(tmp_path))
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+
+
+def test_search_alg_rejects_concurrency(tmp_path):
+    with pytest.raises(ValueError, match="sequential"):
+        tune.run(lambda c: None, config={"x": tune.uniform(0, 1)},
+                 num_samples=2, metric="m", mode="min",
+                 search_alg=tune.TPESearcher(), max_concurrent_trials=2,
+                 local_dir=str(tmp_path))
+
+
+def test_oversized_partition_rejected(tmp_path):
+    with pytest.raises(ValueError, match="exceeds"):
+        tune.run(lambda c: None, config={}, num_samples=1,
+                 max_concurrent_trials=2, devices_per_trial=64,
+                 local_dir=str(tmp_path))
+
+
+def test_scheduler_with_concurrent_trials(tmp_path):
+    """ASHA decisions across overlapping trials must not corrupt state."""
+    def trainable(config):
+        for step in range(6):
+            tune.report(score=config["v"] + step * 0.01)
+            if tune.trial_should_stop():
+                return
+
+    analysis = tune.run(
+        trainable, config={"v": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        num_samples=1, metric="score", mode="max",
+        scheduler=tune.ASHAScheduler(max_t=6, grace_period=2,
+                                     reduction_factor=2),
+        max_concurrent_trials=2, devices_per_trial=4,
+        local_dir=str(tmp_path))
+    assert analysis.best_result["score"] >= 1.0
+    assert all(t.status in ("TERMINATED", "STOPPED")
+               for t in analysis.trials)
