@@ -70,6 +70,11 @@ class TransformerConfig:
     # decode KV cache (and its HBM traffic) by n_heads/n_kv_heads;
     # None = multi-head attention (kv heads == query heads)
     n_kv_heads: Optional[int] = None
+    # sliding-window (Mistral-style) causal attention: each token sees at
+    # most the last `sliding_window` tokens; None = full causal.  Not
+    # combinable with a sharded sequence axis (ring/Ulysses are full-
+    # attention strategies)
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -231,13 +236,19 @@ class GPT(TpuModule):
     def _attention(self, q, k, v):
         if self.mesh is not None and mesh_lib.mesh_axis_size(
                 self.mesh, mesh_lib.SEQUENCE_AXIS) > 1:
+            if self.cfg.sliding_window is not None:
+                raise NotImplementedError(
+                    "sliding_window with a sharded sequence axis is not "
+                    "supported; use ring/ulysses full attention or an "
+                    "unsharded sequence")
             if self.cfg.context_parallel == "ulysses":
                 from ..parallel.ulysses import ulysses_attention_sharded
                 return ulysses_attention_sharded(q, k, v, self.mesh,
                                                  causal=self.cfg.causal)
             return ring_attention_sharded(q, k, v, self.mesh,
                                           causal=self.cfg.causal)
-        return flash_attention(q, k, v, self.cfg.causal)
+        return flash_attention(q, k, v, self.cfg.causal,
+                               window=self.cfg.sliding_window)
 
     def _dropout(self, x, rng):
         p = self.cfg.dropout
@@ -550,7 +561,10 @@ class GPT(TpuModule):
             b, kvh, groups, cfg.head_dim)
         s = jnp.einsum("bkgd,bktd->bkgt", qg, ck.astype(jnp.float32)
                        ) * cfg.head_dim ** -0.5
-        mask = jnp.arange(ck.shape[2]) <= pos
+        t = jnp.arange(ck.shape[2])
+        mask = t <= pos
+        if cfg.sliding_window is not None:
+            mask &= t > pos - cfg.sliding_window
         s = jnp.where(mask[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bkgt,bktd->bkgd", p, cv.astype(jnp.float32))

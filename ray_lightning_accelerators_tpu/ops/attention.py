@@ -36,17 +36,25 @@ _NEG_INF = -1e30
 # --------------------------------------------------------------------- #
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = False,
-                        scale: Optional[float] = None) -> jax.Array:
-    """Plain XLA attention.  q,k,v: [batch, heads, seq, head_dim]."""
+                        scale: Optional[float] = None,
+                        window: Optional[int] = None) -> jax.Array:
+    """Plain XLA attention.  q,k,v: [batch, heads, seq, head_dim].
+
+    ``window``: sliding-window (Mistral-style) causal attention — query i
+    sees keys in [i-window+1, i].  Implies causal masking.
+    """
     *_, q_len, head_dim = q.shape
     k_len = k.shape[-2]
     scale = scale if scale is not None else head_dim ** -0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    if causal:
+    if causal or window is not None:
         qi = jax.lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
-        logits = jnp.where(qi[None, None] >= ki[None, None], logits, _NEG_INF)
+        mask = qi >= ki
+        if window is not None:
+            mask &= (qi - ki) < window
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
@@ -55,7 +63,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 # Pallas kernel                                                         #
 # --------------------------------------------------------------------- #
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  window: Optional[int]):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     last_k = pl.num_programs(2) - 1
@@ -66,8 +75,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: blocks strictly above the diagonal contribute nothing
+    # causal: blocks strictly above the diagonal contribute nothing;
+    # sliding window additionally skips blocks entirely left of every
+    # query's window start
     needed = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+    if window is not None:
+        needed = needed & (ki * block_k + block_k - 1
+                           >= qi * block_q - window + 1)
 
     @pl.when(needed)
     def _compute():
@@ -76,12 +90,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
-        if causal:
+        if causal or window is not None:
             rows = jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            qpos = qi * block_q + rows
+            kpos = ki * block_k + cols
+            mask = qpos >= kpos
+            if window is not None:
+                mask &= (qpos - kpos) < window
             s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_scr[:, :1]                        # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -104,13 +122,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
 def _flash_forward(q3: jax.Array, k3: jax.Array, v3: jax.Array, scale: float,
                    causal: bool, block_q: int, block_k: int,
-                   interpret: bool) -> jax.Array:
+                   interpret: bool, window: Optional[int] = None) -> jax.Array:
     """q3,k3,v3: [bh, seq, d] (batch*heads folded)."""
     bh, q_len, d = q3.shape
     k_len = k3.shape[1]
     grid = (bh, q_len // block_q, k_len // block_k)
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               window=window)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -139,38 +158,43 @@ def _use_pallas(q: jax.Array, block_q: int, block_k: int) -> bool:
     return q_len % block_q == 0 and q.shape[-2] % block_k == 0 and d >= 64
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+                    block_q: int = 128, block_k: int = 128,
+                    window: Optional[int] = None) -> jax.Array:
     """Fused attention.  q,k,v: [batch, heads, seq, head_dim].
 
     Uses the Pallas TPU kernel when shapes allow, XLA reference otherwise.
+    ``window`` enables sliding-window causal attention (see
+    attention_reference).
     """
     b, h, q_len, d = q.shape
     scale_v = scale if scale is not None else d ** -0.5
     if not _use_pallas(q, block_q, block_k):
-        return attention_reference(q, k, v, causal=causal, scale=scale_v)
+        return attention_reference(q, k, v, causal=causal, scale=scale_v,
+                                   window=window)
     q3 = q.reshape(b * h, q_len, d)
     k3 = k.reshape(b * h, k.shape[2], d)
     v3 = v.reshape(b * h, v.shape[2], d)
     out = _flash_forward(q3, k3, v3, scale_v, causal,
                          min(block_q, q_len), min(block_k, k.shape[2]),
-                         interpret=False)
+                         interpret=False, window=window)
     return out.reshape(b, h, q_len, d)
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, window):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, window)
     return out, (q, k, v)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, residuals, g):
+def _fa_bwd(causal, scale, block_q, block_k, window, residuals, g):
     q, k, v = residuals
     # flash-style recompute: grads of the reference formulation, fused by XLA
     _, vjp = jax.vjp(
         lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
+                                               scale=scale, window=window),
+        q, k, v)
     return vjp(g)
 
 
@@ -178,7 +202,7 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
 def flash_attention_interpret(q, k, v, causal=False, scale=None,
-                              block_q=128, block_k=128):
+                              block_q=128, block_k=128, window=None):
     """Interpreter-mode kernel entry (CPU correctness tests)."""
     b, h, q_len, d = q.shape
     scale_v = scale if scale is not None else d ** -0.5
@@ -186,5 +210,5 @@ def flash_attention_interpret(q, k, v, causal=False, scale=None,
     k3 = k.reshape(b * h, k.shape[2], d)
     v3 = v.reshape(b * h, v.shape[2], d)
     out = _flash_forward(q3, k3, v3, scale_v, causal, block_q, block_k,
-                         interpret=True)
+                         interpret=True, window=window)
     return out.reshape(b, h, q_len, d)

@@ -190,3 +190,15 @@ def test_top_p_sampling():
     b = model.generate(params, prompt, max_new_tokens=6, temperature=0.9,
                        top_p=0.8, rng=jax.random.PRNGKey(3))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sliding_window_decode_matches_naive():
+    cfg = TransformerConfig(vocab_size=97, d_model=64, n_heads=2, d_ff=128,
+                            n_layers=2, max_seq_len=48, sliding_window=6)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.default_rng(9).integers(0, 97, size=(2, 10)), jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=12)
+    ref = _naive_generate(model, params, prompt, 12)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -69,3 +69,50 @@ def test_cpu_dispatch_falls_back():
     ref = attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def _brute_window(q, k, v, window):
+    ql = q.shape[2]
+    qi = np.arange(ql)[:, None]
+    ki = np.arange(ql)[None, :]
+    mask = (qi >= ki) & (qi - ki < window)
+    logits = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) \
+        * q.shape[-1] ** -0.5
+    logits = np.where(mask[None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+
+
+@pytest.mark.parametrize("window", [64, 100, 256])
+def test_sliding_window_reference(window):
+    q, k, v = _qkv(s=256)
+    out = attention_reference(q, k, v, causal=True, window=window)
+    ref = _brute_window(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), ref, **_TOL)
+
+
+@pytest.mark.parametrize("window", [64, 100])
+def test_sliding_window_kernel_matches(window):
+    q, k, v = _qkv(s=256)
+    out = flash_attention_interpret(q, k, v, causal=True, block_q=128,
+                                    block_k=128, window=window)
+    ref = attention_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **_TOL)
+
+
+def test_sliding_window_gradients():
+    q, k, v = _qkv(b=1, h=2, s=128, d=64)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, window=48) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True,
+                                           window=48) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   **_GRAD_TOL)
