@@ -734,7 +734,11 @@ class Trainer:
             dataloaders = dataloaders or datamodule.test_dataloader()
         return self._eval_entry(module, dataloaders, "_test_step_fn", "test")
 
-    def predict(self, module: TpuModule, dataloaders=None) -> List[Any]:
+    def predict(self, module: TpuModule, dataloaders=None,
+                datamodule=None) -> List[Any]:
+        if datamodule is not None:
+            datamodule.setup("predict")
+            dataloaders = dataloaders or datamodule.predict_dataloader()
         self.module = module
         module.trainer = self
         self.accelerator.setup_environment()

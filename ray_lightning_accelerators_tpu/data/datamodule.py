@@ -21,3 +21,6 @@ class DataModule:
 
     def test_dataloader(self) -> Optional[DataLoader]:
         return None
+
+    def predict_dataloader(self) -> Optional[DataLoader]:
+        return self.test_dataloader()

@@ -163,3 +163,16 @@ def test_val_check_interval_mid_epoch():
                        default_root_dir="/tmp/vci_test2")
     trainer2.fit(model2, train, val)
     assert model2.val_epoch == 3
+
+
+def test_predict_with_datamodule():
+    from tests.utils import BlobsDataModule, LinearClassifier
+    dm = BlobsDataModule(n=128, batch_size=16)
+    model = LinearClassifier()
+    trainer = Trainer(max_epochs=3, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir="/tmp/pred_dm_test")
+    trainer.fit(model, datamodule=dm)
+    preds = trainer.predict(model, datamodule=dm)
+    assert len(preds) > 0
+    assert all(np.asarray(p).shape[0] > 0 for p in preds)
