@@ -268,9 +268,16 @@ class GPT(TpuModule):
         k = _rope(k, positions, cfg.rope_theta)
         q = self._constrain(q, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
                             mesh_lib.SEQUENCE_AXIS, None)
-        k = self._constrain(k, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
+        # GQA may leave fewer kv heads than the tensor axis can divide;
+        # replicate kv over tensor in that case instead of crashing the
+        # sharding constraint
+        t_size = (mesh_lib.mesh_axis_size(self.mesh, mesh_lib.TENSOR_AXIS)
+                  if self.mesh is not None else 1)
+        kv_axis = (mesh_lib.TENSOR_AXIS
+                   if t_size <= 1 or cfg.kv_heads % t_size == 0 else None)
+        k = self._constrain(k, mesh_lib.BATCH_AXES, kv_axis,
                             mesh_lib.SEQUENCE_AXIS, None)
-        v = self._constrain(v, mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
+        v = self._constrain(v, mesh_lib.BATCH_AXES, kv_axis,
                             mesh_lib.SEQUENCE_AXIS, None)
         groups = cfg.n_heads // cfg.kv_heads
         if groups > 1:  # GQA: broadcast each KV head over its query group
@@ -610,14 +617,16 @@ class GPT(TpuModule):
         if top_k:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
-        if top_p and top_p < 1.0:
+        if top_p < 1.0:
             # nucleus: drop the tail whose cumulative prob exceeds top_p.
             # sort descending once; a token survives if the cumulative mass
-            # BEFORE it is < top_p (the head token always survives)
+            # BEFORE it is < top_p (the head token always survives — the
+            # max(..., 0) keeps it even for top_p=0, which is thus greedy)
             sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
             probs = jax.nn.softmax(sorted_logits, axis=-1)
             cum = jnp.cumsum(probs, axis=-1) - probs
-            cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), -1) - 1
+            cutoff_idx = jnp.maximum(
+                jnp.sum((cum < top_p).astype(jnp.int32), -1) - 1, 0)
             cutoff = jnp.take_along_axis(sorted_logits,
                                          cutoff_idx[:, None], axis=-1)
             logits = jnp.where(logits < cutoff, -1e30, logits)

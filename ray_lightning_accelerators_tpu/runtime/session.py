@@ -45,11 +45,17 @@ def _current() -> Optional[TpuSession]:
 
 
 def init_session(rank: int, queue: Optional[Any] = None) -> None:
+    install_session(TpuSession(rank, queue))
+
+
+def install_session(session: TpuSession) -> None:
+    """Set an existing session object as the process global (so callers
+    that also thread-bind it keep ONE session object, not two twins)."""
     global _session
     if _session is not None:
         raise ValueError("a session already exists in this process; "
                          "call shutdown_session() first")
-    _session = TpuSession(rank, queue)
+    _session = session
 
 
 def bind_session_to_thread(session: Optional[TpuSession]) -> None:

@@ -222,7 +222,7 @@ def _execute_trial(trainable, trial: Trial, scheduler, devices,
     session_lib.bind_session_to_thread(rt)
     if set_global:
         _trial_session = tsess
-        session_lib.init_session(rank=0, queue=q)
+        session_lib.install_session(rt)
 
     def _bind_worker():  # runs on the pool's worker thread
         _bind_trial_session(tsess)
@@ -331,10 +331,17 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
             finally:
                 free.put(group)
 
-        with ThreadPoolExecutor(max_workers=n_groups) as outer:
+        outer = ThreadPoolExecutor(max_workers=n_groups)
+        try:
             futures = [outer.submit(_leased, t) for t in trials]
             for f in futures:
                 f.result()  # propagate raise_on_failed_trial errors
+        except BaseException:
+            # fail-fast parity with sequential mode: un-started trials are
+            # cancelled (already-running ones finish their lease)
+            outer.shutdown(wait=True, cancel_futures=True)
+            raise
+        outer.shutdown(wait=True)
         return ExperimentAnalysis(trials, metric, mode)
 
     trials = []

@@ -202,3 +202,10 @@ def test_sliding_window_decode_matches_naive():
     out = model.generate(params, prompt, max_new_tokens=12)
     ref = _naive_generate(model, params, prompt, 12)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_top_p_zero_is_greedy():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5]])
+    for seed in range(5):
+        t = GPT._sample(logits, 1.0, 0, 0.0, jax.random.PRNGKey(seed))
+        assert int(t[0]) == 1  # argmax survives, everything else masked

@@ -98,3 +98,20 @@ def test_scheduler_with_concurrent_trials(tmp_path):
     assert analysis.best_result["score"] >= 1.0
     assert all(t.status in ("TERMINATED", "STOPPED")
                for t in analysis.trials)
+
+
+def test_concurrent_fail_fast_cancels_pending(tmp_path):
+    import time as _time
+
+    def trainable(config):
+        if config["i"] == 0:
+            raise RuntimeError("boom")
+        _time.sleep(0.4)
+        tune.report(x=1.0)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        tune.run(trainable,
+                 config={"i": tune.grid_search(list(range(8)))},
+                 num_samples=1, metric="x", mode="max",
+                 max_concurrent_trials=2, devices_per_trial=4,
+                 local_dir=str(tmp_path))

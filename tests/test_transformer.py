@@ -203,3 +203,17 @@ def test_dropout_with_remat():
     loss, _ = jax.jit(lambda pp: m.training_step(
         pp, toks, jax.random.PRNGKey(1)))(p)
     assert np.isfinite(float(loss))
+
+
+def test_gqa_under_tensor_parallelism():
+    """kv_heads smaller than the tensor axis must replicate, not crash."""
+    from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+    cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=8, d_ff=128,
+                            n_layers=1, max_seq_len=32, n_kv_heads=2)
+    m = GPT(cfg)
+    m.mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=2, tensor=4))
+    p = m.init_params(jax.random.PRNGKey(0))
+    toks = jnp.ones((4, 32), jnp.int32)
+    loss, _ = jax.jit(lambda pp: m.training_step(
+        pp, toks, jax.random.PRNGKey(0)))(p)
+    assert np.isfinite(float(loss))
