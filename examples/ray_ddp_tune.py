@@ -35,13 +35,17 @@ def train_mnist(config, num_epochs=10, num_workers=1, smoke=False):
     trainer = Trainer(
         max_epochs=num_epochs,
         callbacks=[TuneReportCallback(metrics, on="validation_end")],
+        # tune.trial_devices() is this trial's device partition under
+        # --parallel-trials; None (= all devices) otherwise
         accelerator=RayTPUAccelerator(num_workers=num_workers,
+                                      devices=tune.trial_devices(),
                                       init_hook=prepare_data),
         default_root_dir=os.path.join(tempfile.gettempdir(), "rla_tpu_tune"))
     trainer.fit(model, datamodule=dm)
 
 
-def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False):
+def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False,
+               parallel_trials=1, use_tpe=False):
     config = {
         "layer_1": tune.choice([32, 64, 128]),
         "layer_2": tune.choice([64, 128, 256]),
@@ -51,6 +55,8 @@ def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False):
     analysis = tune.run(
         lambda cfg: train_mnist(cfg, num_epochs, num_workers, smoke),
         config=config, num_samples=num_samples, metric="loss", mode="min",
+        search_alg=tune.TPESearcher(seed=0) if use_tpe else None,
+        max_concurrent_trials=parallel_trials,
         name="tune_mnist")
     print("Best hyperparameters found were:", analysis.best_config)
 
@@ -60,9 +66,15 @@ if __name__ == "__main__":
     parser.add_argument("--num-workers", type=int, default=1)
     parser.add_argument("--num-epochs", type=int, default=10)
     parser.add_argument("--num-samples", type=int, default=10)
+    parser.add_argument("--parallel-trials", type=int, default=1,
+                        help="run N trials concurrently on disjoint "
+                             "device partitions")
+    parser.add_argument("--tpe", action="store_true",
+                        help="model-based TPE search instead of random")
     parser.add_argument("--smoke-test", action="store_true")
     args = parser.parse_args()
     if args.smoke_test:
         args.num_epochs, args.num_samples = 1, 1
     tune_mnist(args.num_samples, args.num_epochs, args.num_workers,
-               args.smoke_test)
+               args.smoke_test, parallel_trials=args.parallel_trials,
+               use_tpe=args.tpe)
