@@ -521,9 +521,13 @@ class GPT(TpuModule):
     # position.  No reference analog (predict there is plain model(x),
     # reference: ray_lightning/tests/utils.py:137-152).
 
-    def _prefill(self, params, tokens, total_len):
+    def _prefill(self, params, tokens, cache_len):
         """Run the prompt once; returns (last-position hidden [B,d],
-        cache dict with k/v [L,B,H,total_len,D])."""
+        cache dict with k/v [L,B,H,cache_len,D]).
+
+        ``cache_len < prompt_len`` (the sliding-window rolling cache) keeps
+        only the last ``cache_len`` positions, scattered to their ring
+        slots ``p % cache_len``."""
         dt = self.compute_dtype
         h = self._wt(params["embed"], dt)[tokens]
         pos = jnp.arange(tokens.shape[1])
@@ -533,18 +537,29 @@ class GPT(TpuModule):
             return h_new, (k, v)
 
         h, (ks, vs) = jax.lax.scan(block, h, params["layers"])
-        pad = total_len - tokens.shape[1]
-        cache = {
-            "k": jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))),
-            "v": jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))),
-        }
+        s0 = tokens.shape[1]
+        if s0 <= cache_len:
+            pad = cache_len - s0
+            cache = {
+                "k": jnp.pad(ks, ((0, 0),) * 3 + ((0, pad), (0, 0))),
+                "v": jnp.pad(vs, ((0, 0),) * 3 + ((0, pad), (0, 0))),
+            }
+        else:
+            slots = jnp.arange(s0 - cache_len, s0) % cache_len
+            zk = jnp.zeros(ks.shape[:3] + (cache_len, ks.shape[-1]),
+                           ks.dtype)
+            cache = {
+                "k": zk.at[:, :, :, slots, :].set(ks[:, :, :, -cache_len:]),
+                "v": zk.at[:, :, :, slots, :].set(vs[:, :, :, -cache_len:]),
+            }
         h = self._rms_norm(h, params["ln_f"])
         return h[:, -1], cache
 
     def _decode_block(self, h, lp, ck, cv, pos):
-        """One layer, one token.  h: [B,1,d]; ck/cv: [B,H,total,D] with this
-        layer's keys/values for positions < pos already written.  Returns
-        (h_out, k_new, v_new) where k/v_new are this token's projections."""
+        """One layer, one token.  h: [B,1,d]; ck/cv: [B,H,W,D] — a ring
+        buffer over slots ``p % W`` (W == max length makes it the plain
+        linear cache: slot == position).  Returns (h_out, updated caches).
+        """
         cfg = self.cfg
         dt = self.compute_dtype
         a = lp["attn"]
@@ -555,10 +570,12 @@ class GPT(TpuModule):
         v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+        W = ck.shape[2]
+        slot = jax.lax.rem(pos, W)
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, 0, pos, 0))
+                                          (0, 0, slot, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, 0, pos, 0))
+                                          (0, 0, slot, 0))
         # grouped single-query attention over the (unrepeated) KV cache,
         # masked to written slots; groups=1 is plain MHA
         b = q.shape[0]
@@ -568,10 +585,11 @@ class GPT(TpuModule):
             b, kvh, groups, cfg.head_dim)
         s = jnp.einsum("bkgd,bktd->bkgt", qg, ck.astype(jnp.float32)
                        ) * cfg.head_dim ** -0.5
-        t = jnp.arange(ck.shape[2])
-        mask = t <= pos
-        if cfg.sliding_window is not None:
-            mask &= t > pos - cfg.sliding_window
+        # ring-buffer validity: once pos >= W every slot holds a position
+        # in (pos-W, pos] — exactly the attention span (the cache is sized
+        # to min(total, sliding_window)); before that, slots <= pos
+        t = jnp.arange(W)
+        mask = (t <= pos) | (pos >= W)
         s = jnp.where(mask[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bkgt,bktd->bkgd", p, cv.astype(jnp.float32))
@@ -656,7 +674,9 @@ class GPT(TpuModule):
         # length need not divide those axes)
         mesh_saved, self.mesh = self.mesh, None
         try:
-            h_last, cache = self._prefill(params, prompt, total)
+            window = self.cfg.sliding_window
+            cache_len = total if window is None else min(total, window)
+            h_last, cache = self._prefill(params, prompt, cache_len)
             dt = self.compute_dtype
             logits0 = (h_last @ self._unembed_w(params, dt)
                        ).astype(jnp.float32)

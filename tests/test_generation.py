@@ -209,3 +209,32 @@ def test_top_p_zero_is_greedy():
     for seed in range(5):
         t = GPT._sample(logits, 1.0, 0, 0.0, jax.random.PRNGKey(seed))
         assert int(t[0]) == 1  # argmax survives, everything else masked
+
+
+def test_sliding_window_rolling_cache_deep_wrap():
+    """Cache is sized to the window and wraps many times; tokens must stay
+    exact against naive full re-forward."""
+    cfg = TransformerConfig(vocab_size=97, d_model=64, n_heads=2, d_ff=128,
+                            n_layers=2, max_seq_len=64, sliding_window=4)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.default_rng(11).integers(0, 97, size=(2, 10)), jnp.int32)
+    # cache shape is the window, not total
+    _, cache = model._prefill(params, prompt, 4)
+    assert cache["k"].shape[3] == 4
+    out = model.generate(params, prompt, max_new_tokens=20)
+    ref = _naive_generate(model, params, prompt, 20)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sliding_window_prompt_shorter_than_window():
+    cfg = TransformerConfig(vocab_size=97, d_model=64, n_heads=2, d_ff=128,
+                            n_layers=2, max_seq_len=64, sliding_window=16)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.default_rng(12).integers(0, 97, size=(1, 4)), jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=24)
+    ref = _naive_generate(model, params, prompt, 24)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
