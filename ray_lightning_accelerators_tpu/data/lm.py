@@ -49,6 +49,76 @@ class CharTokenizer:
         return "".join(self._to_char.get(int(i), "") for i in ids)
 
 
+class BPETokenizer:
+    """Byte-level byte-pair encoding, trained on a corpus; no external
+    dependencies.
+
+    Ids 0/1 reserved for pad/eos (shared convention with CharTokenizer);
+    base ids 2..257 are the 256 byte values; merges extend upward.  Any
+    input text round-trips exactly (byte fallback), unlike CharTokenizer
+    which rejects unseen characters.
+    """
+
+    PAD_ID = 0
+    EOS_ID = 1
+    _BASE = 2
+
+    def __init__(self, corpus: str, vocab_size: int = 512):
+        if vocab_size < self._BASE + 256:
+            raise ValueError(f"vocab_size must be >= {self._BASE + 256}")
+        self.merges: Dict[tuple, int] = {}  # (id, id) -> merged id
+        data = list(corpus.encode("utf-8"))
+        ids = [b + self._BASE for b in data]
+        next_id = self._BASE + 256
+        while next_id < vocab_size:
+            counts: Dict[tuple, int] = {}
+            for a, b in zip(ids, ids[1:]):
+                counts[(a, b)] = counts.get((a, b), 0) + 1
+            if not counts:
+                break
+            pair = max(counts, key=counts.get)
+            if counts[pair] < 2:
+                break  # nothing left worth merging
+            self.merges[pair] = next_id
+            ids = self._merge(ids, pair, next_id)
+            next_id += 1
+        self.vocab_size = vocab_size
+        # decode table: id -> bytes
+        self._bytes: Dict[int, bytes] = {
+            b + self._BASE: bytes([b]) for b in range(256)}
+        for (a, b), m in self.merges.items():
+            self._bytes[m] = self._bytes[a] + self._bytes[b]
+
+    @staticmethod
+    def _merge(ids: List[int], pair: tuple, new_id: int) -> List[int]:
+        out, i = [], 0
+        while i < len(ids):
+            if i + 1 < len(ids) and (ids[i], ids[i + 1]) == pair:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(ids[i])
+                i += 1
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids = [b + self._BASE for b in text.encode("utf-8")]
+        # apply merges in training order (ranks): repeatedly merge the
+        # lowest-rank pair present
+        while len(ids) >= 2:
+            ranked = [(self.merges[p], p) for p in zip(ids, ids[1:])
+                      if p in self.merges]
+            if not ranked:
+                break
+            _, pair = min(ranked)
+            ids = self._merge(ids, pair, self.merges[pair])
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        chunks = [self._bytes.get(int(i), b"") for i in ids]
+        return b"".join(chunks).decode("utf-8", errors="replace")
+
+
 def pack_sequences(docs: Sequence[Sequence[int]], seq_len: int,
                    eos_id: Optional[int] = CharTokenizer.EOS_ID,
                    drop_remainder: bool = True,

@@ -146,3 +146,36 @@ def test_pack_stream_generator_docs_constant_memory():
     rows = list(pack_stream(one_huge_doc(), seq_len=128, eos_id=None))
     assert len(rows) == 10_000 // 128
     assert rows[0][0] == 2 and rows[1][0] == (128 % 250) + 2
+
+
+def test_bpe_roundtrip_and_compression():
+    from ray_lightning_accelerators_tpu.data.lm import BPETokenizer
+    corpus = synthetic_corpus(100)
+    tok = BPETokenizer(corpus, vocab_size=400)
+    text = "the pod shards the batch. a chip compiles every gradient."
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    # merges actually fire: shorter than byte-length
+    assert len(ids) < len(text.encode("utf-8"))
+    # unseen characters still round-trip (byte fallback)
+    weird = "zebra Ω 字"
+    assert tok.decode(tok.encode(weird)) == weird
+    # ids stay within vocab and off the reserved range
+    assert max(ids) < 400 and min(ids) >= 2
+
+
+def test_bpe_vocab_floor():
+    from ray_lightning_accelerators_tpu.data.lm import BPETokenizer
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer("abc", vocab_size=100)
+
+
+def test_bpe_feeds_packer():
+    from ray_lightning_accelerators_tpu.data.lm import (BPETokenizer,
+                                                        pack_sequences)
+    corpus = synthetic_corpus(50)
+    tok = BPETokenizer(corpus, vocab_size=300)
+    docs = [tok.encode(d) for d in corpus.split("\n\n")]
+    rows = pack_sequences(docs, seq_len=32)
+    assert rows.shape[1] == 32 and rows.shape[0] > 0
+    assert rows.max() < 300
