@@ -189,3 +189,41 @@ def device_memory_stats() -> List[Dict[str, Any]]:
         except Exception:
             out.append({})
     return out
+
+
+# --------------------------------------------------------------------- #
+# FLOPs / MFU estimation                                                 #
+# --------------------------------------------------------------------- #
+def flops_estimate(fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs for one invocation of (jit-able) ``fn`` on these args, from
+    XLA's compiled cost analysis.  None when the backend reports no
+    estimate.  Trace-only: nothing executes on device."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    try:
+        analyses = compiled.cost_analysis()
+    except Exception:
+        return None
+    if not analyses:
+        return None
+    a = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
+    flops = a.get("flops")
+    return float(flops) if flops else None
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        peak_flops: Optional[float] = None) -> float:
+    """Model FLOPs utilization: achieved/peak.  ``peak_flops`` defaults to
+    a per-chip bf16 estimate for the current backend (v5e ~197 TFLOP/s;
+    0.0 is returned when unknown so callers can gate on it)."""
+    import jax
+
+    if peak_flops is None:
+        kind = (jax.devices()[0].device_kind or "").lower()
+        peaks = {"v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+                 "v4": 275e12, "v5p": 459e12, "v6e": 918e12}
+        peak_flops = next((v for k, v in peaks.items() if k in kind), 0.0)
+        if not peak_flops:
+            return 0.0
+    return flops_per_step / (step_time_s * peak_flops)

@@ -110,3 +110,21 @@ def test_device_memory_stats_shape():
     stats = device_memory_stats()
     assert len(stats) == len(jax.local_devices())
     assert all(isinstance(d, dict) for d in stats)
+
+
+def test_flops_estimate_and_mfu():
+    import jax.numpy as jnp
+    from ray_lightning_accelerators_tpu.utils.profiler import (flops_estimate,
+                                                               mfu)
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    fl = flops_estimate(f, a, b)
+    if fl is not None:  # cpu backend may omit cost analysis
+        # matmul flops = 2*M*N*K
+        assert fl == pytest.approx(2 * 128 * 256 * 64, rel=0.5)
+    # explicit peak: 1 TFLOP/s peak, 1e9 flops in 1ms = 100% MFU
+    assert mfu(1e9, 1e-3, peak_flops=1e12) == pytest.approx(1.0)
