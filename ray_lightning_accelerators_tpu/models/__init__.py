@@ -17,6 +17,7 @@ _EXPORTS = {
     "synthetic_cifar10": "resnet",
     "GPT": "transformer", "TransformerConfig": "transformer",
     "ViT": "vit", "ViTConfig": "vit",
+    "speculative_generate": "speculative",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -1,0 +1,120 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+No reference analog (the reference has no inference stack at all).  Greedy
+speculative decoding is EXACT: the output token sequence is identical to
+target-only greedy decode, but the target runs once per ~accepted-run of
+draft tokens instead of once per token — and its chunk forward
+(`GPT._decode_chunk`) scores k positions in one pass, turning k
+bandwidth-bound single-token reads of the weights into one.  Wall-clock
+win ≈ (mean accepted run length) / (1 + cost_draft/cost_target · k).
+
+Mechanics worth noting:
+
+- **No cache rollback.**  Both caches are linear (slot == position) and
+  every attention mask stops at the current position, so entries written
+  for rejected draft tokens are never attended and are overwritten when
+  real tokens land on those positions.
+- **Self-repairing feed.**  Each round feeds "the last token" (which may
+  be a correction the model never processed) at its position, so both
+  models' caches stay consistent without special cases.
+- Greedy only (exactness is the contract); batch size 1 (acceptance
+  length varies per row); rolling-window caches unsupported (the chunk
+  path needs linear slots).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import GPT
+
+
+def speculative_generate(target: GPT, target_params,
+                         draft: GPT, draft_params,
+                         prompt, max_new_tokens: int,
+                         k: int = 4) -> Tuple[jax.Array, dict]:
+    """Greedy decode of ``max_new_tokens`` tokens, exact vs target-only
+    greedy.  Returns (tokens [1, prompt+new], stats dict with
+    ``rounds``/``accept_rate``).
+
+    ``draft`` and ``target`` must share the vocabulary; ``k`` is the
+    number of tokens drafted per round.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative decoding supports batch size 1")
+    if target.cfg.sliding_window is not None or \
+            draft.cfg.sliding_window is not None:
+        raise NotImplementedError(
+            "speculative decoding needs linear caches (sliding_window "
+            "unsupported)")
+    target_params = jax.tree.map(jnp.asarray, target_params)
+    draft_params = jax.tree.map(jnp.asarray, draft_params)
+    s0 = prompt.shape[1]
+    total = s0 + max_new_tokens
+    for m, name in ((target, "target"), (draft, "draft")):
+        if total > m.cfg.max_seq_len:
+            raise ValueError(f"{name} max_seq_len {m.cfg.max_seq_len} < "
+                             f"{total}")
+
+    t_mesh, target.mesh = target.mesh, None
+    d_mesh, draft.mesh = draft.mesh, None
+    try:
+        # caches get k slots of headroom: the final round may draft/score
+        # up to k positions past the last needed token, and an
+        # out-of-range dynamic_update_slice would silently CLAMP onto (and
+        # corrupt) the last real slots
+        cache_len = total + k
+        h_t, t_cache = target._prefill(target_params, prompt, cache_len)
+        _, d_cache = draft._prefill(draft_params, prompt, cache_len)
+
+        d_step = jax.jit(lambda c, tok, p: draft._decode_token(
+            draft_params, c, tok, p))
+        t_chunk = jax.jit(lambda c, toks, p: target._decode_chunk(
+            target_params, c, toks, p))
+
+        dt = target.compute_dtype
+        first = jnp.argmax(
+            (h_t @ target._unembed_w(target_params, dt)).astype(jnp.float32),
+            -1).astype(jnp.int32)  # token at position s0
+        out = [int(first[0])]
+        rounds = 0
+        accepted_total = 0
+        while len(out) < max_new_tokens:
+            rounds += 1
+            pos = s0 + len(out) - 1   # position of the newest token
+            last = jnp.asarray([out[-1]], jnp.int32)
+            # draft proposes k tokens (its cache absorbs `last` first)
+            drafts = []
+            tok = last
+            p = pos
+            for _ in range(k):
+                logits, d_cache = d_step(d_cache, tok, p)
+                tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                drafts.append(int(tok[0]))
+                p += 1
+            # target scores [last, d_1..d_{k-1}] in ONE chunk pass:
+            # logits[i] predicts position pos+i+1 (validates drafts[i])
+            chunk = jnp.asarray([[out[-1]] + drafts[:-1]], jnp.int32)
+            t_logits, t_cache = t_chunk(t_cache, chunk, pos)
+            greedy = np.asarray(jnp.argmax(t_logits[0], -1))
+            accept = 0
+            while accept < k and greedy[accept] == drafts[accept] and \
+                    len(out) + accept + 1 < max_new_tokens:
+                accept += 1
+            accepted_total += accept
+            new = drafts[:accept] + [int(greedy[accept])] \
+                if accept < k else drafts[:accept]
+            out.extend(new[:max_new_tokens - len(out)])
+        tokens = jnp.concatenate(
+            [prompt, jnp.asarray([out], jnp.int32)], axis=1)
+        stats = {"rounds": rounds,
+                 "accept_rate": accepted_total / max(rounds * k, 1)}
+        return tokens, stats
+    finally:
+        target.mesh = t_mesh
+        draft.mesh = d_mesh

@@ -608,6 +608,74 @@ class GPT(TpuModule):
             h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
         return h, ck, cv
 
+    def _decode_chunk_block(self, h, lp, ck, cv, pos0):
+        """One layer, a CHUNK of n tokens at positions pos0..pos0+n-1
+        (speculative-decoding scoring path; linear cache only).  h:
+        [B,n,d]; ck/cv: [B,H,W,D].  Causal within the chunk and over the
+        cache prefix."""
+        cfg = self.cfg
+        dt = self.compute_dtype
+        a = lp["attn"]
+        n = h.shape[1]
+        x = self._rms_norm(h, lp["ln1"])
+        positions = pos0 + jnp.arange(n)
+        q = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, 0, pos0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, 0, pos0, 0))
+        b = q.shape[0]
+        kvh = ck.shape[1]
+        groups = cfg.n_heads // kvh
+        qg = q.astype(jnp.float32).reshape(
+            b, kvh, groups, n, cfg.head_dim)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, ck.astype(jnp.float32)
+                       ) * cfg.head_dim ** -0.5
+        t = jnp.arange(ck.shape[2])[None, None, None, None]
+        rows = positions[None, None, None, :, None]
+        s = jnp.where(t <= rows, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bkgqt,bktd->bkgqd", p, cv.astype(jnp.float32))
+        attn = attn.reshape(b, cfg.n_heads, n, cfg.head_dim).astype(dt)
+        h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
+        x = self._rms_norm(h, lp["ln2"])
+        m = self._dequant_q8_leaves(lp["mlp"], dt)
+        if cfg.num_experts > 1:
+            y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           compute_dtype=dt, mesh=self.mesh)
+            h = h + y
+        else:
+            up = jax.nn.gelu(
+                jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
+            h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
+        return h, ck, cv
+
+    def _decode_chunk(self, params, cache, tokens, pos0):
+        """Score a chunk of n tokens against the cache in one pass.
+        tokens: [B,n] fed at positions pos0..pos0+n-1.  Returns (logits
+        [B,n,V] f32, updated cache) — logits[:, i] predicts position
+        pos0+i+1.  Requires the linear (non-rolling) cache."""
+        dt = self.compute_dtype
+        h = self._wt(params["embed"], dt)[tokens]
+
+        def layer(carry, xs):
+            lp, ck, cv = xs
+            h_out, ck2, cv2 = self._decode_chunk_block(carry, lp, ck, cv,
+                                                       pos0)
+            return h_out, (ck2, cv2)
+
+        h, (cks, cvs) = jax.lax.scan(
+            layer, h, (params["layers"], cache["k"], cache["v"]))
+        h = self._rms_norm(h, params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", h, self._unembed_w(params, dt)
+                            ).astype(jnp.float32)
+        return logits, {"k": cks, "v": cvs}
+
     def _decode_token(self, params, cache, token, pos):
         """Full-depth single-token step.  token: [B] int32.  Returns
         (logits [B,V] f32, updated cache)."""

@@ -1,0 +1,110 @@
+"""Speculative decoding: exactness vs target-only greedy, acceptance
+statistics, chunk-scorer correctness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.models.speculative import (
+    speculative_generate)
+from ray_lightning_accelerators_tpu.models.transformer import (
+    GPT, TransformerConfig)
+
+
+def _model(layers, seed, heads=2, kv=None):
+    cfg = TransformerConfig(vocab_size=61, d_model=64, n_heads=heads,
+                            d_ff=128, n_layers=layers, max_seq_len=64,
+                            n_kv_heads=kv)
+    m = GPT(cfg)
+    return m, m.init_params(jax.random.PRNGKey(seed))
+
+
+def test_chunk_scorer_matches_stepwise():
+    """_decode_chunk over n tokens == n sequential _decode_token calls."""
+    model, params = _model(2, 0)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 61, size=(1, 6)), jnp.int32)
+    total = 16
+    _, cache_a = model._prefill(params, prompt, total)
+    _, cache_b = model._prefill(params, prompt, total)
+    toks = jnp.asarray([[7, 11, 13]], jnp.int32)
+    chunk_logits, _ = model._decode_chunk(params, cache_a, toks, 5)
+    step_logits = []
+    for i in range(3):
+        lg, cache_b = model._decode_token(params, cache_b, toks[:, i],
+                                          jnp.asarray(5 + i))
+        step_logits.append(lg)
+    np.testing.assert_allclose(np.asarray(chunk_logits[0]),
+                               np.asarray(jnp.stack(step_logits, 1)[0]),
+                               atol=2e-4, rtol=2e-4)
+
+
+def _assert_greedy_equivalent(target, tp, out, ref, tie_tol=1e-3):
+    """Outputs must match token-for-token, except that a divergence is
+    allowed at a genuine logit near-tie (the chunk and step scorers use
+    different einsum reduction orders, so fp ties may break differently —
+    after a tie the contexts legitimately differ)."""
+    out, ref = np.asarray(out), np.asarray(ref)
+    if np.array_equal(out, ref):
+        return
+    first = int(np.argmax(out[0] != ref[0]))
+    # re-score the shared prefix with the target; the two tokens chosen
+    # at the divergence must be (near-)tied under the target
+    logits = np.asarray(target.forward(tp, jnp.asarray(ref[:, :first])))
+    last = logits[0, -1]
+    gap = abs(float(last[out[0, first]]) - float(last[ref[0, first]]))
+    assert gap < tie_tol, (
+        f"divergence at {first} is not a logit tie (gap={gap})")
+
+
+@pytest.mark.parametrize("draft_layers,k", [(1, 4), (2, 3)])
+def test_speculative_exact_vs_greedy(draft_layers, k):
+    target, tp = _model(3, 0)
+    draft, dp = _model(draft_layers, 1)
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 61, size=(1, 8)), jnp.int32)
+    ref = target.generate(tp, prompt, max_new_tokens=14)
+    out, stats = speculative_generate(target, tp, draft, dp, prompt,
+                                      max_new_tokens=14, k=k)
+    assert out.shape == ref.shape
+    _assert_greedy_equivalent(target, tp, out, ref)
+    assert stats["rounds"] >= 1
+    assert 0.0 <= stats["accept_rate"] <= 1.0
+
+
+def test_speculative_self_draft_accepts_everything():
+    """Draft == target: every proposal matches, so rounds ~= tokens/k."""
+    target, tp = _model(2, 0)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    out, stats = speculative_generate(target, tp, target, tp, prompt,
+                                      max_new_tokens=12, k=4)
+    ref = target.generate(tp, prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["accept_rate"] > 0.7
+    assert stats["rounds"] <= 4
+
+
+def test_speculative_with_gqa_target():
+    target, tp = _model(2, 0, heads=4, kv=2)
+    draft, dp = _model(1, 3)
+    prompt = jnp.ones((1, 5), jnp.int32)
+    ref = target.generate(tp, prompt, max_new_tokens=10)
+    out, _ = speculative_generate(target, tp, draft, dp, prompt,
+                                  max_new_tokens=10, k=4)
+    _assert_greedy_equivalent(target, tp, out, ref)
+
+
+def test_speculative_rejects_batch_and_window():
+    target, tp = _model(1, 0)
+    draft, dp = _model(1, 1)
+    with pytest.raises(ValueError, match="batch"):
+        speculative_generate(target, tp, draft, dp,
+                             jnp.ones((2, 4), jnp.int32), 4)
+    swcfg = TransformerConfig(vocab_size=61, d_model=64, n_heads=2,
+                              d_ff=128, n_layers=1, max_seq_len=64,
+                              sliding_window=8)
+    sw = GPT(swcfg)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        speculative_generate(sw, sw.init_params(jax.random.PRNGKey(0)),
+                             draft, dp, jnp.ones((1, 4), jnp.int32), 4)
