@@ -72,8 +72,20 @@ def speculative_generate(target: GPT, target_params,
         h_t, t_cache = target._prefill(target_params, prompt, cache_len)
         _, d_cache = draft._prefill(draft_params, prompt, cache_len)
 
-        d_step = jax.jit(lambda c, tok, p: draft._decode_token(
-            draft_params, c, tok, p))
+        def _draft_k(cache, tok, pos):
+            # all k draft steps in ONE dispatch (a host loop of k jit calls
+            # would pay k tunnel round-trips per round)
+            def step(carry, i):
+                c, t = carry
+                logits, c = draft._decode_token(draft_params, c, t, pos + i)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (c, nxt), nxt
+
+            (cache, _), toks = jax.lax.scan(
+                step, (cache, tok), jnp.arange(k))
+            return cache, toks[:, 0]  # [k] drafted tokens
+
+        d_propose = jax.jit(_draft_k)
         t_chunk = jax.jit(lambda c, toks, p: target._decode_chunk(
             target_params, c, toks, p))
 
@@ -89,14 +101,9 @@ def speculative_generate(target: GPT, target_params,
             pos = s0 + len(out) - 1   # position of the newest token
             last = jnp.asarray([out[-1]], jnp.int32)
             # draft proposes k tokens (its cache absorbs `last` first)
-            drafts = []
-            tok = last
-            p = pos
-            for _ in range(k):
-                logits, d_cache = d_step(d_cache, tok, p)
-                tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                drafts.append(int(tok[0]))
-                p += 1
+            d_cache, draft_toks = d_propose(d_cache, last,
+                                            jnp.asarray(pos))
+            drafts = [int(t) for t in np.asarray(draft_toks)]
             # target scores [last, d_1..d_{k-1}] in ONE chunk pass:
             # logits[i] predicts position pos+i+1 (validates drafts[i])
             chunk = jnp.asarray([[out[-1]] + drafts[:-1]], jnp.int32)

@@ -383,10 +383,6 @@ class GPT(TpuModule):
         logits = logits.astype(jnp.float32)
         return (logits, aux) if return_aux else logits
 
-    def _unembed(self, params) -> jax.Array:
-        return (params["embed"].T if self.cfg.tie_embeddings
-                else params["unembed"])
-
     def _use_fused_loss(self) -> bool:
         """Batch (data/fsdp) sharding is handled inside the op via
         shard_map; seq/tensor/pipeline sharding of the hidden states or the
@@ -415,7 +411,7 @@ class GPT(TpuModule):
             rows = h[:, :-1].reshape(-1, d)
             targets = tokens[:, 1:].reshape(-1).astype(jnp.int32)
             loss, acc = fused_linear_cross_entropy(
-                rows, self._unembed(params).astype(self.compute_dtype),
+                rows, self._unembed_w(params, self.compute_dtype),
                 targets, self.cfg.loss_chunk_rows, mesh=self.mesh)
             return loss, acc, aux
         logits, aux = self.forward(params, tokens, return_aux=True,
