@@ -62,6 +62,11 @@ class TransformerConfig:
     # [B,S,V] logits; engaged when the mesh doesn't shard seq/tensor/pipe
     fused_loss: bool = True
     loss_chunk_rows: int = 1024
+    # loss shaping: eps-smoothed targets (regularization) and the PaLM
+    # z-loss term z * logsumexp(logits)^2 (keeps the softmax normalizer
+    # near 1 — the standard bf16-training stability knob)
+    label_smoothing: float = 0.0
+    z_loss: float = 0.0
     # context-parallel strategy over the `sequence` mesh axis:
     # "ring" (KV neighbor exchange) or "ulysses" (head/seq all-to-all;
     # needs n_heads % sequence_axis == 0)
@@ -412,14 +417,24 @@ class GPT(TpuModule):
             targets = tokens[:, 1:].reshape(-1).astype(jnp.int32)
             loss, acc = fused_linear_cross_entropy(
                 rows, self._unembed_w(params, self.compute_dtype),
-                targets, self.cfg.loss_chunk_rows, mesh=self.mesh)
+                targets, self.cfg.loss_chunk_rows, mesh=self.mesh,
+                label_smoothing=self.cfg.label_smoothing,
+                z_loss=self.cfg.z_loss)
             return loss, acc, aux
         logits, aux = self.forward(params, tokens, return_aux=True,
                                    dropout_rng=rng)
-        targets = tokens[:, 1:]
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], targets).mean()
-        acc = jnp.mean(jnp.argmax(logits[:, :-1], -1) == targets)
+        logits, targets = logits[:, :-1], tokens[:, 1:]
+        eps, zl = self.cfg.label_smoothing, self.cfg.z_loss
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, targets[..., None],
+                                        axis=-1)[..., 0]
+        loss = lse - (1.0 - eps) * tgt_logit
+        if eps:
+            loss -= (eps / logits.shape[-1]) * jnp.sum(logits, -1)
+        if zl:
+            loss += zl * lse * lse
+        loss = loss.mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == targets)
         return loss, acc, aux
 
     def training_step(self, params, batch, rng):

@@ -67,23 +67,35 @@ def _pad_rows(h: jax.Array, targets: jax.Array, chunk: int):
     return h, targets, nc
 
 
-def _chunk_stats(h_c: jax.Array, w: jax.Array, tgt_c: jax.Array):
+def _chunk_stats(h_c: jax.Array, w: jax.Array, tgt_c: jax.Array,
+                 label_smoothing: float, z_loss: float):
     """Per-chunk forward: returns (sum loss, sum correct, n valid).
 
     The matmul runs in the inputs' dtype (bf16 from the model) with f32
-    accumulation — MXU-native — instead of upcasting the operands."""
+    accumulation — MXU-native — instead of upcasting the operands.
+
+    Per row: ``lse - (1-eps)*tgt_logit - (eps/V)*sum(logits)`` (cross
+    entropy against the eps-smoothed target distribution) plus the PaLM
+    stability term ``z_loss * lse**2`` that keeps the softmax normalizer
+    near 1."""
     valid = tgt_c >= 0
     tgt = jnp.where(valid, tgt_c, 0)
     logits = jnp.dot(h_c, w, preferred_element_type=jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)
     tgt_logit = jnp.take_along_axis(logits, tgt[:, None], axis=-1)[:, 0]
-    loss_sum = jnp.sum(jnp.where(valid, lse - tgt_logit, 0.0))
+    row_loss = lse - (1.0 - label_smoothing) * tgt_logit
+    if label_smoothing:
+        row_loss -= (label_smoothing / w.shape[1]) * jnp.sum(logits, -1)
+    if z_loss:
+        row_loss += z_loss * lse * lse
+    loss_sum = jnp.sum(jnp.where(valid, row_loss, 0.0))
     correct = jnp.sum(jnp.where(valid, jnp.argmax(logits, -1) == tgt, 0))
     return loss_sum, correct.astype(jnp.float32), \
         jnp.sum(valid).astype(jnp.float32)
 
 
-def _streamed_sums_impl(h, w, targets, chunk_rows):
+def _streamed_sums_impl(h, w, targets, chunk_rows, label_smoothing,
+                        z_loss):
     rows, d = h.shape
     hp, tp, nc = _pad_rows(h, targets, chunk_rows)
     hcs = hp.reshape(nc, chunk_rows, d)
@@ -91,14 +103,15 @@ def _streamed_sums_impl(h, w, targets, chunk_rows):
 
     def one(args):
         h_c, t_c = args
-        return _chunk_stats(h_c, w, t_c)
+        return _chunk_stats(h_c, w, t_c, label_smoothing, z_loss)
 
     loss_sums, corrects, valids = jax.lax.map(one, (hcs, tcs))
     return jnp.sum(loss_sums), jnp.sum(corrects), jnp.sum(valids)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _streamed_sums(h, w, targets, chunk_rows, psum_axes=()):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _streamed_sums(h, w, targets, chunk_rows, psum_axes=(),
+                   label_smoothing=0.0, z_loss=0.0):
     """(loss_sum, correct_sum, n_valid) streamed over row chunks; only
     loss_sum carries gradient.
 
@@ -106,14 +119,17 @@ def _streamed_sums(h, w, targets, chunk_rows, psum_axes=()):
     those mesh axes, the backward all-reduces dW over them itself — the
     shard_map transpose cannot infer that the custom bwd's dW needs
     replication (it would reject the out_spec otherwise)."""
-    return _streamed_sums_impl(h, w, targets, chunk_rows)
+    return _streamed_sums_impl(h, w, targets, chunk_rows, label_smoothing,
+                               z_loss)
 
 
-def _sums_fwd(h, w, targets, chunk_rows, psum_axes):
-    return _streamed_sums_impl(h, w, targets, chunk_rows), (h, w, targets)
+def _sums_fwd(h, w, targets, chunk_rows, psum_axes, label_smoothing,
+              z_loss):
+    return _streamed_sums_impl(h, w, targets, chunk_rows, label_smoothing,
+                               z_loss), (h, w, targets)
 
 
-def _sums_bwd(chunk_rows, psum_axes, res, g):
+def _sums_bwd(chunk_rows, psum_axes, label_smoothing, z_loss, res, g):
     h, w, targets = res
     scale = g[0].astype(jnp.float32)  # correct/valid counts carry no grad
     rows, d = h.shape
@@ -127,8 +143,15 @@ def _sums_bwd(chunk_rows, psum_axes, res, g):
         tgt = jnp.where(valid, t_c, 0)
         logits = jnp.dot(h_c, w, preferred_element_type=jnp.float32)
         p = jax.nn.softmax(logits, axis=-1)
-        # d loss_sum / d logits = softmax - onehot(target), masked rows zero
-        gl = p - jax.nn.one_hot(tgt, w.shape[1], dtype=jnp.float32)
+        # d row_loss / d logits = p*(1 + 2*z*lse) - (1-eps)*onehot - eps/V
+        coef = 1.0
+        if z_loss:
+            lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+            coef = 1.0 + 2.0 * z_loss * lse
+        gl = p * coef - (1.0 - label_smoothing) * jax.nn.one_hot(
+            tgt, w.shape[1], dtype=jnp.float32)
+        if label_smoothing:
+            gl -= label_smoothing / w.shape[1]
         gl = jnp.where(valid[:, None], gl, 0.0) * scale
         glc = gl.astype(h_c.dtype)  # grads ride the MXU in compute dtype
         dh_c = jnp.dot(glc, w.T, preferred_element_type=jnp.float32
@@ -161,7 +184,9 @@ def _batch_axes_in(mesh) -> Tuple[str, ...]:
 def fused_linear_cross_entropy(h: jax.Array, w: jax.Array,
                                targets: jax.Array,
                                chunk_rows: int = DEFAULT_CHUNK_ROWS,
-                               mesh=None) -> Tuple[jax.Array, jax.Array]:
+                               mesh=None, label_smoothing: float = 0.0,
+                               z_loss: float = 0.0
+                               ) -> Tuple[jax.Array, jax.Array]:
     """Streaming LM-head loss.  h: [rows, d], w: [d, V], targets: [rows]
     int32 (negative entries masked).  Returns (mean_loss f32, accuracy f32);
     only ``mean_loss`` is differentiable (accuracy grad is zero).
@@ -175,18 +200,22 @@ def fused_linear_cross_entropy(h: jax.Array, w: jax.Array,
     dim of ``h``/``targets`` must then be sharded over exactly those axes.
     """
     if mesh is not None and _batch_axes_in(mesh):
-        return _fused_sharded(h, w, targets, chunk_rows, mesh)
-    ls, cs, n = _streamed_sums(h, w, targets, chunk_rows)
+        return _fused_sharded(h, w, targets, chunk_rows, mesh,
+                              label_smoothing, z_loss)
+    ls, cs, n = _streamed_sums(h, w, targets, chunk_rows, (),
+                               label_smoothing, z_loss)
     n = jnp.maximum(n, 1.0)
     return ls / n, cs / n
 
 
-def _fused_sharded(h, w, targets, chunk_rows, mesh):
+def _fused_sharded(h, w, targets, chunk_rows, mesh, label_smoothing=0.0,
+                   z_loss=0.0):
     axes = _batch_axes_in(mesh)
     P = jax.sharding.PartitionSpec
 
     def body(h_l, w_r, t_l):
-        ls, cs, n = _streamed_sums(h_l, w_r, t_l, chunk_rows, axes)
+        ls, cs, n = _streamed_sums(h_l, w_r, t_l, chunk_rows, axes,
+                                   label_smoothing, z_loss)
         ls = jax.lax.psum(ls, axes)
         cs = jax.lax.psum(cs, axes)
         n = jnp.maximum(jax.lax.psum(n, axes), 1.0)
