@@ -729,6 +729,83 @@ class GPT(TpuModule):
             logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(rng, logits).astype(jnp.int32)
 
+    def generate_beam(self, params, prompt, max_new_tokens: int,
+                      beam_size: int = 4,
+                      length_penalty: float = 1.0) -> jax.Array:
+        """Beam-search decode.  prompt: [1, S0]; returns the best sequence
+        [1, S0 + max_new_tokens] by length-normalized log-probability
+        (sum logp / n^length_penalty).
+
+        Beams ride the batch dimension of the shared KV cache; each step
+        re-gathers cache rows by surviving parents — a [beam] gather, not
+        a copy of history.  Static shapes throughout (single scan).
+        """
+        prompt = jnp.asarray(prompt, jnp.int32)
+        if prompt.shape[0] != 1:
+            raise ValueError("beam search expects batch size 1")
+        params = jax.tree.map(jnp.asarray, params)
+        b, s0 = prompt.shape
+        total = s0 + max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(f"prompt + new tokens ({total}) exceeds "
+                             f"max_seq_len ({self.cfg.max_seq_len})")
+        window = self.cfg.sliding_window
+        cache_len = total if window is None else min(total, window)
+        mesh_saved, self.mesh = self.mesh, None
+        try:
+            h_last, cache = self._prefill(params, prompt, cache_len)
+            dt = self.compute_dtype
+            logp0 = jax.nn.log_softmax(
+                (h_last @ self._unembed_w(params, dt)).astype(jnp.float32))
+            # seed beams from the top-k first tokens (pad with -inf beams
+            # when beam_size exceeds the vocab; they can never win)
+            k0 = min(beam_size, logp0.shape[-1])
+            scores, tok0 = jax.lax.top_k(logp0[0], k0)
+            if k0 < beam_size:
+                scores = jnp.concatenate(
+                    [scores, jnp.full((beam_size - k0,), -1e30)])
+                tok0 = jnp.concatenate(
+                    [tok0, jnp.zeros((beam_size - k0,), tok0.dtype)])
+            cache = jax.tree.map(
+                lambda c: jnp.broadcast_to(
+                    c, c.shape[:1] + (beam_size,) + c.shape[2:]
+                ).copy() if c.ndim >= 2 else c, cache)
+
+            def step(carry, i):
+                cache, toks, scores = carry
+                logits, cache = self._decode_token(params, cache, toks,
+                                                   s0 + i)
+                logp = jax.nn.log_softmax(logits)          # [beam, V]
+                totals = scores[:, None] + logp
+                flat_scores, flat_idx = jax.lax.top_k(
+                    totals.reshape(-1), beam_size)
+                parents = flat_idx // logp.shape[1]
+                new_toks = (flat_idx % logp.shape[1]).astype(jnp.int32)
+                cache = jax.tree.map(
+                    lambda c: jnp.take(c, parents, axis=1), cache)
+                return (cache, new_toks, flat_scores), (parents, new_toks)
+
+            (cache, last, scores), (parents, toks) = jax.lax.scan(
+                step, (cache, tok0.astype(jnp.int32), scores),
+                jnp.arange(max_new_tokens - 1))
+
+            # backtrack the best beam through the parent pointers
+            n_steps = max_new_tokens - 1
+            best = jnp.argmax(scores / (max_new_tokens ** length_penalty))
+
+            def back(beam, i):
+                step_i = n_steps - 1 - i
+                tok = toks[step_i, beam]
+                return parents[step_i, beam], tok
+
+            beam, rev = jax.lax.scan(back, best, jnp.arange(n_steps))
+            seq = jnp.concatenate(
+                [tok0[beam][None], rev[::-1]]) if n_steps else \
+                tok0[best][None]
+            return jnp.concatenate([prompt, seq[None]], axis=1)
+        finally:
+            self.mesh = mesh_saved
+
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0,

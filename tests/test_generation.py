@@ -238,3 +238,45 @@ def test_sliding_window_prompt_shorter_than_window():
     out = model.generate(params, prompt, max_new_tokens=24)
     ref = _naive_generate(model, params, prompt, 24)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_beam_size_one_is_greedy():
+    model, params = _model()
+    prompt = jnp.asarray(
+        np.random.default_rng(21).integers(0, 97, size=(1, 6)), jnp.int32)
+    beam = model.generate_beam(params, prompt, max_new_tokens=8, beam_size=1)
+    greedy = model.generate(params, prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+
+
+def test_beam_finds_global_optimum_when_exhaustive():
+    """With beam_size >= all prefixes, beam search is exhaustive and must
+    return the argmax-total-logprob sequence (brute-forced)."""
+    cfg = TransformerConfig(vocab_size=8, d_model=32, n_heads=2, d_ff=64,
+                            n_layers=1, max_seq_len=16)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(3))
+    prompt = jnp.asarray([[2, 5]], jnp.int32)
+    n_new = 3
+    out = model.generate_beam(params, prompt, max_new_tokens=n_new,
+                              beam_size=64, length_penalty=0.0)
+
+    # brute force all 8^3 continuations in ONE batched forward
+    import itertools
+    seqs = np.asarray(list(itertools.product(range(8), repeat=n_new)),
+                      np.int32)                       # [512, 3]
+    toks = np.concatenate(
+        [np.tile(np.asarray(prompt), (len(seqs), 1)), seqs], axis=1)
+    logits = jax.jit(model.forward)(params, jnp.asarray(toks))
+    logp = np.asarray(jax.nn.log_softmax(logits))
+    s0 = prompt.shape[1]
+    scores = sum(logp[np.arange(len(seqs)), s0 - 1 + i, seqs[:, i]]
+                 for i in range(n_new))
+    best_seq = seqs[int(np.argmax(scores))]
+    np.testing.assert_array_equal(np.asarray(out)[0, 2:], best_seq)
+
+
+def test_beam_rejects_batch():
+    model, params = _model()
+    with pytest.raises(ValueError, match="batch"):
+        model.generate_beam(params, jnp.ones((2, 4), jnp.int32), 4)
