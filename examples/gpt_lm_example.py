@@ -17,7 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # runnable as a script from anywhere
 from ray_lightning_accelerators_tpu import (DataLoader, RayTPUAccelerator,
                                             Trainer)
-from ray_lightning_accelerators_tpu.data.lm import (lm_dataset,
+from ray_lightning_accelerators_tpu.data.lm import (BPETokenizer,
+                                                    lm_dataset,
                                                     synthetic_corpus)
 from ray_lightning_accelerators_tpu.models.transformer import (
     GPT, TransformerConfig)
@@ -25,12 +26,16 @@ from ray_lightning_accelerators_tpu.utils import schedules
 
 
 def train_gpt(num_epochs=10, num_workers=None, use_fsdp=False, tensor=1,
-              sequence=1, batch_size=32, seq_len=128, smoke=False):
+              sequence=1, batch_size=32, seq_len=128, smoke=False,
+              bpe=False):
     corpus = synthetic_corpus(60 if smoke else 2000)
-    dataset, tok = lm_dataset(corpus, seq_len)
+    tokenizer = BPETokenizer(corpus, vocab_size=300) if bpe else None
+    dataset, tok = lm_dataset(corpus, seq_len, tokenizer=tokenizer)
+    # BPE compresses ~3-4x: a smoke corpus may pack to very few rows
+    batch_size = max(1, min(batch_size, len(dataset)))
     loader = DataLoader(dataset, batch_size=batch_size, shuffle=True,
                         drop_last=True)
-    steps = max(1, len(loader)) * num_epochs
+    steps = max(10, len(loader) * num_epochs)
     cfg = TransformerConfig(
         vocab_size=max(64, tok.vocab_size), d_model=128, n_heads=4,
         d_ff=512, n_layers=2 if smoke else 4, max_seq_len=seq_len,
@@ -66,6 +71,8 @@ if __name__ == "__main__":
     parser.add_argument("--sequence", type=int, default=1)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--bpe", action="store_true",
+                        help="byte-level BPE tokenizer instead of chars")
     parser.add_argument("--smoke-test", action="store_true")
     args = parser.parse_args()
     train_gpt(num_epochs=1 if args.smoke_test else args.num_epochs,
@@ -73,4 +80,4 @@ if __name__ == "__main__":
               tensor=args.tensor, sequence=args.sequence,
               batch_size=8 if args.smoke_test else args.batch_size,
               seq_len=64 if args.smoke_test else args.seq_len,
-              smoke=args.smoke_test)
+              smoke=args.smoke_test, bpe=args.bpe)
