@@ -47,6 +47,8 @@ def speculative_generate(target: GPT, target_params,
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.shape[0] != 1:
         raise ValueError("speculative decoding supports batch size 1")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
     if target.cfg.sliding_window is not None or \
             draft.cfg.sliding_window is not None:
         raise NotImplementedError(

@@ -730,11 +730,11 @@ class GPT(TpuModule):
         return jax.random.categorical(rng, logits).astype(jnp.int32)
 
     def generate_beam(self, params, prompt, max_new_tokens: int,
-                      beam_size: int = 4,
-                      length_penalty: float = 1.0) -> jax.Array:
-        """Beam-search decode.  prompt: [1, S0]; returns the best sequence
-        [1, S0 + max_new_tokens] by length-normalized log-probability
-        (sum logp / n^length_penalty).
+                      beam_size: int = 4) -> jax.Array:
+        """Beam-search decode.  prompt: [1, S0]; returns the sequence
+        [1, S0 + max_new_tokens] with the highest total log-probability.
+        All beams decode the full length (no EOS termination), so no
+        length normalization applies.
 
         Beams ride the batch dimension of the shared KV cache; each step
         re-gathers cache rows by surviving parents — a [beam] gather, not
@@ -743,6 +743,8 @@ class GPT(TpuModule):
         prompt = jnp.asarray(prompt, jnp.int32)
         if prompt.shape[0] != 1:
             raise ValueError("beam search expects batch size 1")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         params = jax.tree.map(jnp.asarray, params)
         b, s0 = prompt.shape
         total = s0 + max_new_tokens
@@ -791,7 +793,7 @@ class GPT(TpuModule):
 
             # backtrack the best beam through the parent pointers
             n_steps = max_new_tokens - 1
-            best = jnp.argmax(scores / (max_new_tokens ** length_penalty))
+            best = jnp.argmax(scores)
 
             def back(beam, i):
                 step_i = n_steps - 1 - i
@@ -815,6 +817,8 @@ class GPT(TpuModule):
         with static max_new_tokens/temperature/top_k for the compiled path.
         """
         prompt = jnp.asarray(prompt, jnp.int32)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         # post-fit params are host numpy (trainer re-hydration); numpy
         # leaves cannot be indexed by tracers inside the decode scan
         params = jax.tree.map(jnp.asarray, params)

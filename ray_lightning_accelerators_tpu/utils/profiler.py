@@ -222,7 +222,8 @@ def mfu(flops_per_step: float, step_time_s: float,
     if peak_flops is None:
         kind = (jax.devices()[0].device_kind or "").lower()
         peaks = {"v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
-                 "v4": 275e12, "v5p": 459e12, "v6e": 918e12}
+                 "v4": 275e12, "v5p": 459e12,
+                 "v6 lite": 918e12, "v6e": 918e12}
         peak_flops = next((v for k, v in peaks.items() if k in kind), 0.0)
         if not peak_flops:
             return 0.0

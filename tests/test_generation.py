@@ -259,7 +259,7 @@ def test_beam_finds_global_optimum_when_exhaustive():
     prompt = jnp.asarray([[2, 5]], jnp.int32)
     n_new = 3
     out = model.generate_beam(params, prompt, max_new_tokens=n_new,
-                              beam_size=64, length_penalty=0.0)
+                              beam_size=64)
 
     # brute force all 8^3 continuations in ONE batched forward
     import itertools
@@ -280,3 +280,12 @@ def test_beam_rejects_batch():
     model, params = _model()
     with pytest.raises(ValueError, match="batch"):
         model.generate_beam(params, jnp.ones((2, 4), jnp.int32), 4)
+
+
+def test_zero_new_tokens_rejected():
+    model, params = _model()
+    prompt = jnp.ones((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        model.generate(params, prompt, max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        model.generate_beam(params, prompt, max_new_tokens=0)
