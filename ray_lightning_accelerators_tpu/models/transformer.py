@@ -566,64 +566,17 @@ class GPT(TpuModule):
         h = self._rms_norm(h, params["ln_f"])
         return h[:, -1], cache
 
-    def _decode_block(self, h, lp, ck, cv, pos):
-        """One layer, one token.  h: [B,1,d]; ck/cv: [B,H,W,D] — a ring
-        buffer over slots ``p % W`` (W == max length makes it the plain
-        linear cache: slot == position).  Returns (h_out, updated caches).
-        """
-        cfg = self.cfg
-        dt = self.compute_dtype
-        a = lp["attn"]
-        x = self._rms_norm(h, lp["ln1"])
-        positions = pos[None]  # [1]
-        q = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wq"], dt))
-        k = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wk"], dt))
-        v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        W = ck.shape[2]
-        slot = jax.lax.rem(pos, W)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, 0, slot, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, 0, slot, 0))
-        # grouped single-query attention over the (unrepeated) KV cache,
-        # masked to written slots; groups=1 is plain MHA
-        b = q.shape[0]
-        kvh = ck.shape[1]
-        groups = cfg.n_heads // kvh
-        qg = q.astype(jnp.float32)[:, :, 0].reshape(
-            b, kvh, groups, cfg.head_dim)
-        s = jnp.einsum("bkgd,bktd->bkgt", qg, ck.astype(jnp.float32)
-                       ) * cfg.head_dim ** -0.5
-        # ring-buffer validity: once pos >= W every slot holds a position
-        # in (pos-W, pos] — exactly the attention span (the cache is sized
-        # to min(total, sliding_window)); before that, slots <= pos
-        t = jnp.arange(W)
-        mask = (t <= pos) | (pos >= W)
-        s = jnp.where(mask[None, None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bkgt,bktd->bkgd", p, cv.astype(jnp.float32))
-        attn = attn.reshape(b, cfg.n_heads, 1, cfg.head_dim).astype(dt)
-        h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
-        x = self._rms_norm(h, lp["ln2"])
-        m = self._dequant_q8_leaves(lp["mlp"], dt)
-        if cfg.num_experts > 1:
-            y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
-                           capacity_factor=cfg.moe_capacity_factor,
-                           compute_dtype=dt, mesh=self.mesh)
-            h = h + y
-        else:
-            up = jax.nn.gelu(
-                jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
-            h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
-        return h, ck, cv
+    def _decode_attn_block(self, h, lp, ck, cv, pos0, ring: bool):
+        """One layer, n cached-decode tokens at positions pos0..pos0+n-1.
+        h: [B,n,d]; ck/cv: [B,H,W,D].
 
-    def _decode_chunk_block(self, h, lp, ck, cv, pos0):
-        """One layer, a CHUNK of n tokens at positions pos0..pos0+n-1
-        (speculative-decoding scoring path; linear cache only).  h:
-        [B,n,d]; ck/cv: [B,H,W,D].  Causal within the chunk and over the
-        cache prefix."""
+        ``ring=True`` (single-token path, n==1): the cache is a ring
+        buffer over slots ``p % W`` with wrap-around validity — W == max
+        length degenerates to the plain linear cache.  ``ring=False``
+        (speculative chunk scoring): linear slots, causal within the
+        chunk and over the prefix.  One implementation so the two decode
+        paths cannot drift apart (speculative exactness depends on it).
+        """
         cfg = self.cfg
         dt = self.compute_dtype
         a = lp["attn"]
@@ -635,20 +588,31 @@ class GPT(TpuModule):
         v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+        W = ck.shape[2]
+        slot = jax.lax.rem(pos0, W) if ring else pos0
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, 0, pos0, 0))
+                                          (0, 0, slot, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, 0, pos0, 0))
+                                          (0, 0, slot, 0))
+        # grouped query attention over the (unrepeated) KV cache; groups=1
+        # is plain MHA
         b = q.shape[0]
         kvh = ck.shape[1]
         groups = cfg.n_heads // kvh
-        qg = q.astype(jnp.float32).reshape(
-            b, kvh, groups, n, cfg.head_dim)
+        qg = q.astype(jnp.float32).reshape(b, kvh, groups, n, cfg.head_dim)
         s = jnp.einsum("bkgqd,bktd->bkgqt", qg, ck.astype(jnp.float32)
                        ) * cfg.head_dim ** -0.5
-        t = jnp.arange(ck.shape[2])[None, None, None, None]
+        t = jnp.arange(W)[None, None, None, None]
         rows = positions[None, None, None, :, None]
-        s = jnp.where(t <= rows, s, -1e30)
+        if ring:
+            # once a row's position >= W every slot holds a position in
+            # (pos-W, pos] — exactly the attention span (the cache is
+            # sized to min(total, sliding_window)); before that, only
+            # slots <= pos are written
+            mask = (t <= rows) | (rows >= W)
+        else:
+            mask = t <= rows
+        s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bkgqt,bktd->bkgqd", p, cv.astype(jnp.float32))
         attn = attn.reshape(b, cfg.n_heads, n, cfg.head_dim).astype(dt)
@@ -659,12 +623,11 @@ class GPT(TpuModule):
             y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
                            capacity_factor=cfg.moe_capacity_factor,
                            compute_dtype=dt, mesh=self.mesh)
-            h = h + y
         else:
             up = jax.nn.gelu(
                 jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
-            h = h + jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
-        return h, ck, cv
+            y = jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
+        return h + y, ck, cv
 
     def _decode_chunk(self, params, cache, tokens, pos0):
         """Score a chunk of n tokens against the cache in one pass.
@@ -676,8 +639,8 @@ class GPT(TpuModule):
 
         def layer(carry, xs):
             lp, ck, cv = xs
-            h_out, ck2, cv2 = self._decode_chunk_block(carry, lp, ck, cv,
-                                                       pos0)
+            h_out, ck2, cv2 = self._decode_attn_block(carry, lp, ck, cv,
+                                                      pos0, ring=False)
             return h_out, (ck2, cv2)
 
         h, (cks, cvs) = jax.lax.scan(
@@ -696,7 +659,8 @@ class GPT(TpuModule):
         def layer(carry, xs):
             h_in = carry
             lp, ck, cv = xs
-            h_out, ck2, cv2 = self._decode_block(h_in, lp, ck, cv, pos)
+            h_out, ck2, cv2 = self._decode_attn_block(h_in, lp, ck, cv,
+                                                      pos, ring=True)
             return h_out, (ck2, cv2)
 
         h, (cks, cvs) = jax.lax.scan(
