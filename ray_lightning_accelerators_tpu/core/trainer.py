@@ -57,6 +57,7 @@ class Trainer:
     def __init__(self,
                  max_epochs: Optional[int] = None,
                  max_steps: Optional[int] = None,
+                 max_time: Optional[float] = None,
                  accelerator: Optional[Accelerator] = None,
                  callbacks: Optional[Sequence[Callback]] = None,
                  logger: Optional[Logger] = None,
@@ -83,6 +84,9 @@ class Trainer:
             max_epochs = 1000
         self.max_epochs = max_epochs
         self.max_steps = max_steps
+        # wall-clock budget in seconds; checked at step boundaries so the
+        # run ends on a clean step (preemptible/budgeted TPU reservations)
+        self.max_time = max_time
         self.accelerator = accelerator or RayTPUAccelerator()
         self.callbacks: List[Callback] = list(callbacks or [])
         self.default_root_dir = default_root_dir or os.path.join(
@@ -514,6 +518,10 @@ class Trainer:
                     self._mid_epoch_validation(module)
                     self._last_val_step = self.global_step
                 if self.max_steps and self.global_step >= self.max_steps:
+                    self.should_stop = True
+                    break
+                if self.max_time is not None and \
+                        time.perf_counter() - t0 >= self.max_time:
                     self.should_stop = True
                     break
             else:

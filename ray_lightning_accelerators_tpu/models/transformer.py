@@ -774,11 +774,15 @@ class GPT(TpuModule):
 
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0,
+                 top_p: float = 1.0, repetition_penalty: float = 1.0,
                  rng: Optional[jax.Array] = None) -> jax.Array:
         """Greedy (temperature=0) or sampled decode.  prompt: [B, S0] int32.
         Returns [B, S0 + max_new_tokens].  Jit-compatible: wrap in jax.jit
         with static max_new_tokens/temperature/top_k for the compiled path.
+
+        ``repetition_penalty > 1`` divides the logits of every token
+        already present in the sequence (prompt included) by the penalty
+        when positive and multiplies when negative — the CTRL formulation.
         """
         prompt = jnp.asarray(prompt, jnp.int32)
         if max_new_tokens < 1:
@@ -802,20 +806,39 @@ class GPT(TpuModule):
             cache_len = total if window is None else min(total, window)
             h_last, cache = self._prefill(params, prompt, cache_len)
             dt = self.compute_dtype
-            logits0 = (h_last @ self._unembed_w(params, dt)
-                       ).astype(jnp.float32)
+            # presence mask of tokens seen so far, for repetition penalty
+            seen = jax.nn.one_hot(prompt, self.cfg.vocab_size,
+                                  dtype=jnp.bool_).any(axis=1)
+
+            def penalize(logits, seen):
+                if repetition_penalty == 1.0:
+                    return logits
+                scaled = jnp.where(logits > 0,
+                                   logits / repetition_penalty,
+                                   logits * repetition_penalty)
+                return jnp.where(seen, scaled, logits)
+
+            logits0 = penalize(
+                (h_last @ self._unembed_w(params, dt)).astype(jnp.float32),
+                seen)
             rng, r0 = jax.random.split(rng)
             tok0 = self._sample(logits0, temperature, top_k, top_p, r0)
+            seen = seen | jax.nn.one_hot(tok0, self.cfg.vocab_size,
+                                         dtype=jnp.bool_)
 
             def step(carry, i):
-                cache, tok, rng = carry
+                cache, tok, rng, seen = carry
                 logits, cache = self._decode_token(params, cache, tok, s0 + i)
+                logits = penalize(logits, seen)
                 rng, r = jax.random.split(rng)
                 nxt = self._sample(logits, temperature, top_k, top_p, r)
-                return (cache, nxt, rng), nxt
+                seen = seen | jax.nn.one_hot(nxt, self.cfg.vocab_size,
+                                             dtype=jnp.bool_)
+                return (cache, nxt, rng, seen), nxt
 
-            (_, _, _), toks = jax.lax.scan(
-                step, (cache, tok0, rng), jnp.arange(max_new_tokens - 1))
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (cache, tok0, rng, seen),
+                jnp.arange(max_new_tokens - 1))
             out = jnp.concatenate(
                 [prompt, tok0[:, None], toks.transpose(1, 0)], axis=1)
             return out

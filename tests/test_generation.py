@@ -289,3 +289,26 @@ def test_zero_new_tokens_rejected():
         model.generate(params, prompt, max_new_tokens=0)
     with pytest.raises(ValueError, match="max_new_tokens"):
         model.generate_beam(params, prompt, max_new_tokens=0)
+
+
+def test_repetition_penalty_suppresses_repeats():
+    model, params = _model()
+    prompt = jnp.ones((1, 4), jnp.int32)
+    plain = model.generate(params, prompt, max_new_tokens=16)
+    pen = model.generate(params, prompt, max_new_tokens=16,
+                         repetition_penalty=1e6)
+
+    def repeats(seq):
+        seq = list(map(int, np.asarray(seq)[0]))
+        return len(seq) - len(set(seq))
+
+    # an extreme penalty forbids reuse: every generated token (and the
+    # prompt token) appears at most once
+    assert repeats(pen) <= repeats(plain)
+    gen_part = list(map(int, np.asarray(pen)[0, 4:]))
+    assert len(set(gen_part)) == len(gen_part)
+    assert 1 not in gen_part  # prompt token penalized too
+    # penalty=1.0 is the identity
+    same = model.generate(params, prompt, max_new_tokens=16,
+                          repetition_penalty=1.0)
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(plain))

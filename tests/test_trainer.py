@@ -176,3 +176,23 @@ def test_predict_with_datamodule():
     preds = trainer.predict(model, datamodule=dm)
     assert len(preds) > 0
     assert all(np.asarray(p).shape[0] > 0 for p in preds)
+
+
+def test_max_time_stops(tmpdir):
+    import time as _time
+
+    from ray_lightning_accelerators_tpu import Callback
+
+    class SlowCb(Callback):
+        def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+            _time.sleep(0.05)
+
+    trainer = Trainer(default_root_dir=str(tmpdir), max_epochs=1000,
+                      max_time=0.5, precision="f32", seed=0,
+                      enable_checkpointing=False, callbacks=[SlowCb()])
+    train, val = boring_loaders()
+    t0 = _time.perf_counter()
+    trainer.fit(BoringModel(), train, val)
+    assert _time.perf_counter() - t0 < 30
+    assert trainer.should_stop
+    assert trainer.global_step >= 1
