@@ -137,6 +137,18 @@ class ModelCheckpoint(Callback):
         return self.filename.format(epoch=trainer.current_epoch,
                                     step=trainer.global_step)
 
+    @staticmethod
+    def _remove(trainer, path: str) -> None:
+        # No async fence needed even under 'sharded-async': orbax
+        # serializes async saves (a new save waits out the previous
+        # commit), so by the time a sibling is evicted its array commit
+        # has finished -- fencing here would block training on the NEW
+        # checkpoint's commit, making async saves synchronous.  The only
+        # straggler is the meta.json finalize rename, which tolerates a
+        # vanished dir (save_sharded._finalize) and whose opposite race
+        # (rename landing mid-rmtree) remove_checkpoint re-sweeps.
+        remove_checkpoint(path)
+
     def on_validation_end(self, trainer, module) -> None:
         if trainer.sanity_checking or not trainer.fitting or self.save_top_k == 0:
             return
@@ -150,7 +162,7 @@ class ModelCheckpoint(Callback):
                 self._saved.append((0.0, self.best_model_path))
                 while len(self._saved) > max(0, self.save_top_k - 1):
                     _, evicted = self._saved.pop(0)
-                    remove_checkpoint(evicted)
+                    self._remove(trainer, evicted)
             self.best_model_path = path
             return
         current = trainer.callback_metrics.get(self.monitor)
@@ -168,7 +180,7 @@ class ModelCheckpoint(Callback):
             while len(self._saved) > self.save_top_k:
                 _, evicted = self._saved.pop()
                 if evicted != path:
-                    remove_checkpoint(evicted)
+                    self._remove(trainer, evicted)
             if self._is_better(current, self.best_model_score):
                 self.best_model_score = current
                 self.best_model_path = path

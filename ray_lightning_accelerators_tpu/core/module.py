@@ -117,7 +117,12 @@ class TpuModule:
         sharded = sharded_lib.is_sharded_checkpoint(checkpoint_path)
         payload = (sharded_lib.read_metadata(checkpoint_path) if sharded
                    else ckpt_lib.read_checkpoint(checkpoint_path))
-        mod = module if module is not None else cls(**payload.get("hparams", init_kwargs) or init_kwargs)
+        # explicit kwargs win over checkpointed hparams so callers can
+        # override non-reconstructable values (e.g. an lr schedule saved
+        # as its repr string)
+        ctor_kwargs = dict(payload.get("hparams") or {})
+        ctor_kwargs.update(init_kwargs)
+        mod = module if module is not None else cls(**ctor_kwargs)
         rng = jax.random.PRNGKey(0)
         template = mod.init_params(rng)
         if sharded:

@@ -406,6 +406,7 @@ class Trainer:
         self.current_epoch = 0
         self.epochs_completed = 0
         self.global_step = 0
+        self._last_val_step = -1  # stale values skip epoch-end validation
         self.module = module
         module.trainer = self
         module.compute_dtype = self.compute_dtype

@@ -141,6 +141,12 @@ class GPT(TpuModule):
         if isinstance(lr, str):
             # a schedule was checkpointed as its repr (not reconstructable);
             # resume optimization at the default rate unless overridden
+            from ..utils.logging import log
+            log.warning(
+                "GPT: checkpointed lr schedule %s is not reconstructable; "
+                "falling back to constant lr=3e-4 -- pass an explicit "
+                "lr/schedule override to load_from_checkpoint to silence "
+                "this", lr)
             lr = 3e-4
         self.lr = lr
         if callable(lr):

@@ -77,6 +77,12 @@ class ViT(TpuModule):
         self.cfg = config
         if isinstance(lr, str):
             # a schedule was checkpointed as its repr; fall back to default
+            from ..utils.logging import log
+            log.warning(
+                "ViT: checkpointed lr schedule %s is not reconstructable; "
+                "falling back to constant lr=1e-3 -- pass an explicit "
+                "lr/schedule override to load_from_checkpoint to silence "
+                "this", lr)
             lr = 1e-3
         self.lr = lr
         if callable(lr):

@@ -155,10 +155,28 @@ class TPESearcher:
                     else domain.values)
             counts = np.ones(len(cats))  # Laplace smoothing
             for cfg in good:
-                counts[cats.index(cfg[key])] += 1
+                # history may predate a spec change: skip entries whose
+                # value is no longer a category (or that lack the key)
+                if cfg.get(key) in cats:
+                    counts[cats.index(cfg[key])] += 1
             return cats[int(self.rng.choice(len(cats),
                                             p=counts / counts.sum()))]
-        g_obs = np.asarray([self._to_unit(domain, c[key]) for c in good])
+        # same spec-change tolerance as the categorical branch: ignore
+        # history entries that predate this dimension or hold a value from
+        # an earlier, non-numeric spec (e.g. the key used to be a Choice)
+        def usable(c):
+            v = c.get(key)
+            return (isinstance(v, (int, float, np.integer, np.floating))
+                    and not isinstance(v, bool))
+
+        good = [c for c in good if usable(c)]
+        bad = [c for c in bad if usable(c)]
+        if not good and not bad:
+            # brand-new dimension on a warm searcher: explore the whole
+            # domain like cold start would, instead of pinning to mid-range
+            return domain.sample(self.rng)
+        g_obs = (np.asarray([self._to_unit(domain, c[key]) for c in good])
+                 if good else np.asarray([0.5]))
         b_obs = np.asarray([self._to_unit(domain, c[key]) for c in bad]) \
             if bad else np.asarray([0.5])
         bw = max(float(np.std(g_obs)) * len(g_obs) ** -0.2, 0.05)

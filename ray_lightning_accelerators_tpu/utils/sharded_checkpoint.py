@@ -142,5 +142,10 @@ def remove_checkpoint(path: str) -> None:
     """Delete a checkpoint, whether a pickle file or a sharded directory."""
     if os.path.isdir(path):
         shutil.rmtree(path, ignore_errors=True)
+        if os.path.isdir(path):
+            # an async finalize rename can land meta.json mid-traversal,
+            # leaving a dir that is_sharded_checkpoint would mistake for a
+            # complete checkpoint -- sweep again
+            shutil.rmtree(path, ignore_errors=True)
     elif os.path.exists(path):
         os.unlink(path)
