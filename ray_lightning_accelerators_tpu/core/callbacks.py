@@ -138,7 +138,7 @@ class ModelCheckpoint(Callback):
                                     step=trainer.global_step)
 
     @staticmethod
-    def _remove(trainer, path: str) -> None:
+    def _remove(path: str) -> None:
         # No async fence needed even under 'sharded-async': orbax
         # serializes async saves (a new save waits out the previous
         # commit), so by the time a sibling is evicted its array commit
@@ -162,7 +162,7 @@ class ModelCheckpoint(Callback):
                 self._saved.append((0.0, self.best_model_path))
                 while len(self._saved) > max(0, self.save_top_k - 1):
                     _, evicted = self._saved.pop(0)
-                    self._remove(trainer, evicted)
+                    self._remove(evicted)
             self.best_model_path = path
             return
         current = trainer.callback_metrics.get(self.monitor)
@@ -180,7 +180,7 @@ class ModelCheckpoint(Callback):
             while len(self._saved) > self.save_top_k:
                 _, evicted = self._saved.pop()
                 if evicted != path:
-                    self._remove(trainer, evicted)
+                    self._remove(evicted)
             if self._is_better(current, self.best_model_score):
                 self.best_model_score = current
                 self.best_model_path = path

@@ -90,6 +90,22 @@ class TpuModule:
     def save_hyperparameters(self, **kwargs) -> None:
         self.hparams.update(kwargs)
 
+    @staticmethod
+    def coerce_checkpoint_lr(lr, default: float, model_name: str):
+        """An lr *schedule* checkpoints as its repr string (callables are
+        not serializable); on rebuild via load_from_checkpoint that string
+        arrives as the constructor's ``lr``.  Warn and fall back to
+        ``default`` unless the caller overrides."""
+        if not isinstance(lr, str):
+            return lr
+        from ..utils.logging import log
+        log.warning(
+            "%s: checkpointed lr schedule %s is not reconstructable; "
+            "falling back to constant lr=%g -- pass an explicit lr/schedule "
+            "override to load_from_checkpoint to silence this",
+            model_name, lr, default)
+        return default
+
     def __call__(self, batch: Any) -> Any:
         """Eager convenience: run predict_step with the fitted params."""
         if self.params is None:

@@ -138,16 +138,7 @@ class GPT(TpuModule):
             # with the asdict()-serialized config
             config = TransformerConfig(**config)
         self.cfg = config
-        if isinstance(lr, str):
-            # a schedule was checkpointed as its repr (not reconstructable);
-            # resume optimization at the default rate unless overridden
-            from ..utils.logging import log
-            log.warning(
-                "GPT: checkpointed lr schedule %s is not reconstructable; "
-                "falling back to constant lr=3e-4 -- pass an explicit "
-                "lr/schedule override to load_from_checkpoint to silence "
-                "this", lr)
-            lr = 3e-4
+        lr = self.coerce_checkpoint_lr(lr, 3e-4, "GPT")
         self.lr = lr
         if callable(lr):
             self.lr_schedule = lr
@@ -239,6 +230,28 @@ class GPT(TpuModule):
             return sharding_lib.shard_constraint(
                 x, self.mesh, jax.sharding.PartitionSpec(*spec))
         return x
+
+    def _embed_lookup(self, params, tokens):
+        """Token ids -> embedding rows, [*, d].
+
+        The table is vocab-sharded over the tensor axis
+        (param_logical_axes: embed -> ("vocab", "embed")), and XLA cannot
+        partition a gather whose operand is sharded along the gathered
+        dim: it replicates the whole table first ("Involuntary full
+        rematerialization" — a per-step all-gather of the embedding on a
+        TP pod).  When the tensor axis is real, contract over vocab with
+        a one-hot matmul instead: each shard contributes its own rows and
+        the tensor-axis psum assembles the result on the MXU.  Plain
+        gather otherwise (no tensor sharding = no pathology, and gather
+        is cheaper than the [*, V] one-hot)."""
+        dt = self.compute_dtype
+        table = self._wt(params["embed"], dt)
+        t_size = (mesh_lib.mesh_axis_size(self.mesh, mesh_lib.TENSOR_AXIS)
+                  if self.mesh is not None else 1)
+        if t_size <= 1:
+            return table[tokens]
+        onehot = jax.nn.one_hot(tokens, self.cfg.vocab_size, dtype=dt)
+        return jnp.einsum("...v,vd->...d", onehot, table)
 
     def _rms_norm(self, x, scale):
         # fused pallas kernel on TPU, jnp reference elsewhere (ops/norms.py)
@@ -336,7 +349,7 @@ class GPT(TpuModule):
         if dropout_rng is not None and self.cfg.dropout <= 0:
             dropout_rng = None
         dt = self.compute_dtype
-        h = self._wt(params["embed"], dt)[tokens]
+        h = self._embed_lookup(params, tokens)
         h = self._constrain(h, mesh_lib.BATCH_AXES,
                             mesh_lib.SEQUENCE_AXIS, None)
 
@@ -546,7 +559,7 @@ class GPT(TpuModule):
         only the last ``cache_len`` positions, scattered to their ring
         slots ``p % cache_len``."""
         dt = self.compute_dtype
-        h = self._wt(params["embed"], dt)[tokens]
+        h = self._embed_lookup(params, tokens)
         pos = jnp.arange(tokens.shape[1])
 
         def block(carry, lp):
@@ -641,7 +654,7 @@ class GPT(TpuModule):
         [B,n,V] f32, updated cache) — logits[:, i] predicts position
         pos0+i+1.  Requires the linear (non-rolling) cache."""
         dt = self.compute_dtype
-        h = self._wt(params["embed"], dt)[tokens]
+        h = self._embed_lookup(params, tokens)
 
         def layer(carry, xs):
             lp, ck, cv = xs
@@ -660,7 +673,7 @@ class GPT(TpuModule):
         """Full-depth single-token step.  token: [B] int32.  Returns
         (logits [B,V] f32, updated cache)."""
         dt = self.compute_dtype
-        h = self._wt(params["embed"], dt)[token][:, None]  # [B,1,d]
+        h = self._embed_lookup(params, token)[:, None]  # [B,1,d]
 
         def layer(carry, xs):
             h_in = carry

@@ -75,15 +75,7 @@ class ViT(TpuModule):
             # hparams round-trip: load_from_checkpoint calls cls(**hparams)
             config = ViTConfig(**config)
         self.cfg = config
-        if isinstance(lr, str):
-            # a schedule was checkpointed as its repr; fall back to default
-            from ..utils.logging import log
-            log.warning(
-                "ViT: checkpointed lr schedule %s is not reconstructable; "
-                "falling back to constant lr=1e-3 -- pass an explicit "
-                "lr/schedule override to load_from_checkpoint to silence "
-                "this", lr)
-            lr = 1e-3
+        lr = self.coerce_checkpoint_lr(lr, 1e-3, "ViT")
         self.lr = lr
         if callable(lr):
             self.lr_schedule = lr

@@ -124,7 +124,9 @@ class TPESearcher:
         u = float(np.clip(u, 0.0, 1.0))
         if isinstance(domain, LogUniform):
             lo, hi = np.log(domain.lower), np.log(domain.upper)
-            return float(np.exp(lo + u * (hi - lo)))
+            # exp(log(x)) can land a float-ulp outside the bounds
+            return float(np.clip(np.exp(lo + u * (hi - lo)),
+                                 domain.lower, domain.upper))
         if isinstance(domain, RandInt):
             v = domain.lower + u * (domain.upper - domain.lower)
             return int(np.clip(round(v), domain.lower, domain.upper - 1))
@@ -166,8 +168,14 @@ class TPESearcher:
         # an earlier, non-numeric spec (e.g. the key used to be a Choice)
         def usable(c):
             v = c.get(key)
-            return (isinstance(v, (int, float, np.integer, np.floating))
-                    and not isinstance(v, bool))
+            if not isinstance(v, (int, float, np.integer, np.floating)) \
+                    or isinstance(v, bool):
+                return False
+            # the value must also be valid for the CURRENT domain: e.g. a
+            # spec change to LogUniform over old non-positive values would
+            # make _to_unit return nan and poison the whole Parzen fit
+            with np.errstate(invalid="ignore", divide="ignore"):
+                return bool(np.isfinite(self._to_unit(domain, v)))
 
         good = [c for c in good if usable(c)]
         bad = [c for c in bad if usable(c)]

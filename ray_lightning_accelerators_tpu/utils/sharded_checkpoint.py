@@ -82,6 +82,11 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
     else:
         ckptr.save(os.path.join(path, STATE_DIR), state, force=True)
     if jax.process_index() == 0:
+        # the dir can transiently vanish between the array commit and this
+        # write (observed rarely when a prior async save's eviction race
+        # leaves cleanup work in flight in the same process); recreate
+        # rather than crash the save
+        os.makedirs(path, exist_ok=True)
         tmp = os.path.join(path, META_FILE + ".tmp")
         with open(tmp, "w") as f:
             json.dump(metadata, f)
@@ -94,7 +99,12 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
             def _finalize():
                 _async_ckptr.wait_until_finished()
                 try:
-                    os.replace(tmp, os.path.join(path, META_FILE))
+                    # only mark complete if the state tree survived (an
+                    # eviction race can sweep it and leave the recreated
+                    # dir empty -- meta.json alone would make a state-less
+                    # dir look like a restorable checkpoint)
+                    if os.path.isdir(os.path.join(path, STATE_DIR)):
+                        os.replace(tmp, os.path.join(path, META_FILE))
                 except OSError:
                     pass  # checkpoint dir evicted while committing
 
@@ -102,6 +112,19 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
             _finalize_threads.append(t)
             t.start()
         else:
+            if not os.path.isdir(os.path.join(path, STATE_DIR)):
+                # the committed state tree was swept away with the dir;
+                # meta.json must never mark a state-less checkpoint
+                # complete.  Single-host: redo the array save (cheap,
+                # heals the race).  Multi-host: orbax save is a
+                # collective -- process 0 cannot redo it alone, so fail
+                # this save loudly instead of deadlocking the pod.
+                if jax.process_count() > 1:
+                    raise RuntimeError(
+                        f"checkpoint state tree vanished during save: "
+                        f"{path}")
+                ckptr.save(os.path.join(path, STATE_DIR), state,
+                           force=True)
             os.replace(tmp, os.path.join(path, META_FILE))
 
 
