@@ -17,14 +17,17 @@ from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
 
 
 def train_mnist(config, num_epochs=10, num_workers=1, callbacks=None,
-                data_dir=None, smoke=False):
+                data_dir=None, smoke=False, agents=None):
     model = MNISTClassifier(config, data_dir)
     dm = MNISTDataModule(batch_size=config["batch_size"],
                          n_train=2048 if smoke else 55000,
                          n_val=512 if smoke else 5000)
+    accelerator = RayTPUAccelerator(
+        num_workers=num_workers,
+        num_hosts=len(agents) if agents else 1, agents=agents)
     trainer = Trainer(max_epochs=num_epochs,
                       callbacks=list(callbacks or []),
-                      accelerator=RayTPUAccelerator(num_workers=num_workers),
+                      accelerator=accelerator,
                       default_root_dir=os.path.join(tempfile.gettempdir(),
                                                     "rla_tpu_mnist"),
                       enable_progress_bar=True)
@@ -32,7 +35,8 @@ def train_mnist(config, num_epochs=10, num_workers=1, callbacks=None,
     return trainer
 
 
-def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False):
+def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False,
+               agents=None):
     config = {
         "layer_1": tune.choice([32, 64, 128]),
         "layer_2": tune.choice([64, 128, 256]),
@@ -43,7 +47,7 @@ def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False):
     callbacks = [TuneReportCallback(metrics, on="validation_end")]
     analysis = tune.run(
         lambda cfg: train_mnist(cfg, num_epochs, num_workers, callbacks,
-                                smoke=smoke),
+                                smoke=smoke, agents=agents),
         config=config, num_samples=num_samples,
         metric="loss", mode="min",
         resources_per_trial={"cpu": 1, "extra_cpu": num_workers},
@@ -63,18 +67,24 @@ if __name__ == "__main__":
     parser.add_argument("--tune", action="store_true")
     parser.add_argument("--smoke-test", action="store_true")
     parser.add_argument("--address", type=str, default=None,
-                        help="Coordinator address for multi-host runs.")
+                        help="Comma-separated rla-tpu agent addresses "
+                             "(host:port per machine) for multi-host runs; "
+                             "the analog of the reference's ray cluster "
+                             "address (reference: "
+                             "examples/ray_ddp_example.py:160).")
     args = parser.parse_args()
 
     if args.smoke_test:
         args.num_epochs, args.num_samples = 1, 1
+    agents = ([a.strip() for a in args.address.split(",") if a.strip()]
+              if args.address else None)
 
     if args.tune:
         tune_mnist(args.num_samples, args.num_epochs, args.num_workers,
-                   smoke=args.smoke_test)
+                   smoke=args.smoke_test, agents=agents)
     else:
         config = {"layer_1": 128, "layer_2": 256, "lr": 1e-3,
                   "batch_size": 128}
         trainer = train_mnist(config, args.num_epochs, args.num_workers,
-                              smoke=args.smoke_test)
+                              smoke=args.smoke_test, agents=agents)
         print("final metrics:", trainer.callback_metrics)

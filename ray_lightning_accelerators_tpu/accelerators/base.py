@@ -149,6 +149,42 @@ class Accelerator:
                              rng=repl)
 
     # ---------------------------------------------------------------- #
+    # Multi-host launch plan                                            #
+    # ---------------------------------------------------------------- #
+    def launch_spec(self) -> Optional[Dict[str, Any]]:
+        """A multi-machine launch plan for the Trainer's fan-out path, or
+        None to train in-process.  Subclasses with ``num_hosts``
+        implement it (`accelerators/tpu.py`)."""
+        return None
+
+    def validate_process_topology(self) -> None:
+        """Inside a formed multi-process world, a host count that doesn't
+        match the world is a configuration error, not something to degrade
+        silently (reference really placed hosts x slots workers,
+        ray_lightning/ray_horovod.py:107-114)."""
+        num_hosts = getattr(self, "num_hosts", None)
+        if num_hosts and num_hosts > 1 and jax.process_count() > 1 \
+                and num_hosts != jax.process_count():
+            raise ValueError(
+                f"num_hosts={num_hosts} but this distributed world has "
+                f"{jax.process_count()} processes; size num_hosts to the "
+                f"process count (one process per host)")
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Ship-able state for the multi-machine fan-out: built meshes and
+        explicit device lists hold live Device objects that are only
+        meaningful in this process (the reference drops live actor handles
+        the same way, ray_lightning/ray_ddp.py:123-130)."""
+        state = dict(self.__dict__)
+        state["_mesh"] = None
+        if state.get("devices") is not None:
+            log.warning("explicit device list does not transfer across "
+                        "processes; remote workers will use all their "
+                        "visible devices")
+            state["devices"] = None
+        return state
+
+    # ---------------------------------------------------------------- #
     # Lifecycle + parity surface                                        #
     # ---------------------------------------------------------------- #
     def setup_environment(self) -> None:

@@ -44,7 +44,9 @@ class RayTPUAccelerator(Accelerator):
                  pipeline: int = 1, expert: int = 1,
                  dcn_data: int = 1, dcn_pipeline: int = 1,
                  init_hook: Optional[Callable[[], None]] = None,
-                 devices: Optional[list] = None):
+                 devices: Optional[list] = None,
+                 num_hosts: int = 1,
+                 agents: Optional[list] = None):
         dp = -1 if num_workers is None else num_workers
         if use_fsdp:
             cfg = mesh_lib.MeshConfig(data=1, fsdp=dp, tensor=tensor,
@@ -58,6 +60,40 @@ class RayTPUAccelerator(Accelerator):
                          dcn_data=dcn_data, dcn_pipeline=dcn_pipeline,
                          devices=devices)
         self.num_workers = num_workers
+        # multi-host launch plan: with num_hosts > 1 and per-host agents
+        # (kwarg or RLA_TPU_AGENTS env, started via `rla-tpu agent`),
+        # Trainer.fit fans out one process per host through the actor
+        # runtime (the reference's multi-node Ray placement,
+        # ray_lightning/ray_ddp.py:92-97)
+        self.num_hosts = num_hosts
+        self.agents = list(agents) if agents else None
+
+    def launch_spec(self):
+        if self.num_hosts <= 1:
+            return None
+        from ..runtime.agent import agents_from_env
+        agents = self.agents or agents_from_env()
+        if agents is None:
+            log.warning(
+                "%s(num_hosts=%d) has no host agents configured (pass "
+                "agents=... or set RLA_TPU_AGENTS, agents started via "
+                "`rla-tpu agent`); degrading to single-process training "
+                "over local devices", type(self).__name__, self.num_hosts)
+            return None
+        if len(agents) != self.num_hosts:
+            raise ValueError(
+                f"num_hosts={self.num_hosts} but {len(agents)} agent "
+                f"addresses were configured ({agents}); the contract is "
+                f"one process per host -- pass exactly num_hosts agents")
+        if self.num_workers is not None and \
+                self.num_workers % self.num_hosts != 0:
+            raise ValueError(
+                f"num_workers={self.num_workers} must be divisible by "
+                f"num_hosts={self.num_hosts}")
+        per_host = (None if self.num_workers is None
+                    else self.num_workers // self.num_hosts)
+        return {"num_processes": self.num_hosts, "agents": agents,
+                "devices_per_host": per_host}
 
     def select_devices(self):
         # base handles the fully-specified case (truncation + multi-process
@@ -96,17 +132,25 @@ class HorovodRayAccelerator(RayTPUAccelerator):
     """Parity-named hosts x slots accelerator
     (reference: ray_lightning/ray_horovod.py:40, topology at :84-85).
 
-    `num_hosts * num_slots` total batch shards.  On a real pod, `num_hosts`
-    maps to TPU hosts (DCN-separated processes) and `num_slots` to chips per
-    host (ICI neighbours); single-host it degenerates to plain DP, same as
-    the reference on one node.
+    `num_hosts * num_slots` total batch shards.  `num_hosts` binds to real
+    process topology: with per-host agents configured, Trainer.fit places
+    one process per host (the reference's hosts x slots actor placement,
+    ray_horovod.py:107-114); inside an already-formed multi-process world
+    a mismatched num_hosts raises.  Single-process without agents it
+    degrades (with a warning) to plain DP over local devices, same as the
+    reference on one node.
     """
 
     def __init__(self, num_hosts: int = 1, num_slots: int = 1,
                  use_gpu: bool = False,
                  init_hook: Optional[Callable[[], None]] = None, **kwargs):
-        self.num_hosts = num_hosts
         self.num_slots = num_slots
         self.use_gpu = use_gpu
         super().__init__(num_workers=num_hosts * num_slots,
-                         init_hook=init_hook, **kwargs)
+                         init_hook=init_hook, num_hosts=num_hosts, **kwargs)
+
+    def launch_spec(self):
+        spec = super().launch_spec()
+        if spec is not None:
+            spec["devices_per_host"] = self.num_slots
+        return spec

@@ -1,0 +1,58 @@
+"""``rla-tpu`` CLI: per-host agents + multi-machine driver launches.
+
+The reference's multi-node entry is ``ray up cluster.yaml`` +
+``ray submit cluster.yaml train.py`` (reference: README.md:57-62): Ray's
+cluster launcher starts a daemon on every node, then the driver script
+connects with ``ray.init(address=...)``.  The no-Ray equivalent:
+
+1. on every host: ``rla-tpu agent --port 7777``
+2. on the driver: ``rla-tpu launch --agents host1:7777,host2:7777 train.py``
+   (or run the script directly with ``RLA_TPU_AGENTS`` set, or pass
+   ``--address host1:7777,host2:7777`` to the examples)
+
+``launch`` exports the agent list as ``RLA_TPU_AGENTS`` and runs the
+script; anything calling ``runtime.bootstrap.launch_distributed`` (or an
+accelerator with ``num_hosts > 1``) picks the agents up from the
+environment via ``runtime.agent.agents_from_env``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        "rla-tpu", description="TPU training control plane")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent", help="run a per-host worker agent")
+    ag.add_argument("--port", type=int, default=7777)
+    ag.add_argument("--bind", default="0.0.0.0",
+                    help="interface to listen on (agents execute arbitrary "
+                         "pickled code -- bind to trusted networks only)")
+
+    la = sub.add_parser(
+        "launch", help="run a driver script against host agents")
+    la.add_argument("--agents", required=True,
+                    help="comma-separated host:port agent addresses")
+    la.add_argument("script", help="driver python script")
+    la.add_argument("script_args", nargs=argparse.REMAINDER)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "agent":
+        from .runtime.agent import HostAgent
+        HostAgent(args.port, args.bind).serve_forever()
+    elif args.cmd == "launch":
+        import os
+        import runpy
+        import sys
+
+        os.environ["RLA_TPU_AGENTS"] = args.agents
+        sys.argv = [args.script] + list(args.script_args)
+        runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -397,9 +397,101 @@ class Trainer:
     # ------------------------------------------------------------------ #
     # fit                                                                #
     # ------------------------------------------------------------------ #
+    # ------------------------------------------------------------------ #
+    # Multi-machine fan-out (driver mode)                                #
+    # ------------------------------------------------------------------ #
+    # The reference's signature flow: the driver serializes the whole
+    # Trainer into the object store, fans `train_remote` out to actors on
+    # cluster nodes, pumps the trampoline queue while training runs, and
+    # re-hydrates rank-0 results/weights into the driver's model
+    # (reference: ray_lightning/ray_ddp.py:169-193).  Here the actors are
+    # per-host agent workers and the collective substrate is a
+    # jax.distributed world formed before fit runs in each process.
+
+    def _launch_plan(self) -> Optional[Dict[str, Any]]:
+        if os.environ.get("RLA_TPU_INSIDE_WORKER") == "1":
+            return None  # already a fanned-out worker process
+        if jax.process_count() > 1:
+            return None  # already inside a formed distributed world
+        return self.accelerator.launch_spec()
+
+    def _fit_via_launcher(self, spec, module, train_dataloaders,
+                          val_dataloaders, datamodule, ckpt_path) -> None:
+        import functools
+
+        from ..runtime.bootstrap import launch_distributed
+        from ..runtime.queue import TrampolineQueue
+
+        n = spec["num_processes"]
+        env = {"RLA_TPU_INSIDE_WORKER": "1"}
+        platform = cpu_per = None
+        env_platform = os.environ.get("JAX_PLATFORMS",
+                                      "").split(",")[0].lower()
+        if env_platform == "cpu" or jax.default_backend() == "cpu":
+            # CPU fan-out (tests / CI): each worker gets its share of
+            # virtual devices and gloo collectives.  The env var is
+            # honored even when a device plugin overrode the driver's own
+            # backend through jax.config.
+            platform = "cpu"
+            cpu_per = spec.get("devices_per_host") or 1
+            env.update({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""})
+        log.warning("fanning fit out to %d processes via agents %s",
+                    n, spec.get("agents"))
+
+        # the payload must be free of live device/compiled objects: ship
+        # existing params as numpy (refit continuation works through the
+        # fan-out), and clear meshes / jitted fns / device caches a prior
+        # in-process fit left on the trainer and module
+        if module.params is not None:
+            module.params = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), module.params)
+        module.trainer = None  # rebound worker-side and on return
+        self.teardown()
+        self._mesh = None
+        self._val_loader = None
+        if getattr(module, "mesh", None) is not None:
+            module.mesh = None
+        if hasattr(module, "_jit_predict"):
+            del module._jit_predict
+
+        queue = TrampolineQueue()
+        body = functools.partial(_remote_fit_worker, self, module,
+                                 train_dataloaders, val_dataloaders,
+                                 datamodule, ckpt_path)
+        results = launch_distributed(
+            body, n, platform=platform, cpu_devices_per_process=cpu_per,
+            env=env, agents=spec.get("agents"), queue=queue)
+
+        # re-hydrate rank-0 state into the driver's trainer + module
+        # (reference: ray_ddp.py:185-193)
+        r0 = results[0]
+        module.params = r0["params"]
+        module.trainer = self
+        self.module = module
+        self.global_step = r0["global_step"]
+        self.current_epoch = r0["current_epoch"]
+        self.epochs_completed = r0["epochs_completed"]
+        self.callback_metrics = dict(r0["metrics"])
+        for c in self.callbacks:
+            st = r0["callbacks"].get(c.state_key)
+            if st:
+                c.load_state_dict(st)
+        cb = self.checkpoint_callback
+        if cb is not None and r0.get("best_model_path"):
+            # valid on the driver under the shared-FS assumption the
+            # reference also makes (SURVEY.md §5.4)
+            cb.best_model_path = r0["best_model_path"]
+        self.fitting = False
+
     def fit(self, module: TpuModule,
             train_dataloaders=None, val_dataloaders=None,
             datamodule=None, ckpt_path: Optional[str] = None) -> None:
+        plan = self._launch_plan()
+        if plan is not None:
+            return self._fit_via_launcher(plan, module, train_dataloaders,
+                                          val_dataloaders, datamodule,
+                                          ckpt_path)
+        self.accelerator.validate_process_topology()
         t0 = time.perf_counter()
         self.fitting = True
         self.should_stop = False
@@ -772,3 +864,44 @@ class Trainer:
         self._device_cache = None
         self._train_step_cached_fn = None
         self.accelerator.teardown()
+
+
+def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,
+                       val_dataloaders, datamodule, ckpt_path,
+                       process_id: int) -> Optional[Dict[str, Any]]:
+    """Runs INSIDE each fanned-out worker process, after the launcher
+    formed the jax.distributed world (the reference's ``train_remote``,
+    ray_lightning/ray_ddp.py:199-220).  All ranks fit; rank 0 returns the
+    materialized results the driver re-hydrates."""
+    os.environ["RLA_TPU_INSIDE_WORKER"] = "1"
+    trainer.fit(module, train_dataloaders, val_dataloaders,
+                datamodule=datamodule, ckpt_path=ckpt_path)
+
+    def host(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # cross-process shards (FSDP over hosts): collective gather --
+            # every rank participates, mirroring the rank-0 state_dict
+            # shipment (reference: ray_ddp.py:274)
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    params_host = jax.tree.map(host, module.params)
+    if jax.process_index() != 0:
+        return None  # rank-0-only result (reference: ray_horovod.py:160-162)
+    metrics = {}
+    for k, v in trainer.callback_metrics.items():
+        try:
+            metrics[k] = float(v)
+        except (TypeError, ValueError):
+            pass
+    cb_states = {c.state_key: c.state_dict() for c in trainer.callbacks}
+    best = getattr(trainer.checkpoint_callback, "best_model_path", None)
+    return {"params": params_host,
+            "global_step": trainer.global_step,
+            "current_epoch": trainer.current_epoch,
+            "epochs_completed": trainer.epochs_completed,
+            "metrics": metrics,
+            "callbacks": {k: v for k, v in cb_states.items() if v},
+            "best_model_path": best}

@@ -147,22 +147,30 @@ class Worker:
     # ------------------------------------------------------------------ #
     def execute(self, fn: Callable, *args, **kwargs) -> Future:
         """Ship fn to the worker; returns a Future (ObjectRef analog)."""
+        return self.execute_blob(cloudpickle.dumps((fn, args, kwargs)))
+
+    def execute_blob(self, blob: bytes, raw: bool = False) -> Future:
+        """Ship an already-cloudpickled (fn, args, kwargs) blob.
+
+        ``raw=True`` resolves the Future with the wire tuple
+        ``(status, payload_bytes)`` without deserializing -- the host
+        agent relays results to a remote driver this way, so classes only
+        importable driver-side never unpickle on the agent."""
         fut: Future = Future()
-        blob = cloudpickle.dumps((fn, args, kwargs))
         with self._send_lock:
             if not self._proc.is_alive():
                 fut.set_exception(RuntimeError(
                     f"worker {self.rank} is dead"))
                 return fut
             with self._state_lock:
-                self._pending.append(fut)
+                self._pending.append((fut, raw))
             try:
                 self._conn.send_bytes(blob)  # may block; collector still runs
             except (BrokenPipeError, OSError) as e:
                 # worker died between the liveness check and the send
                 with self._state_lock:
-                    if fut in self._pending:
-                        self._pending.remove(fut)
+                    if (fut, raw) in self._pending:
+                        self._pending.remove((fut, raw))
                 fut.set_exception(RuntimeError(
                     f"worker {self.rank} died before accepting work: {e}"))
         return fut
@@ -175,17 +183,19 @@ class Worker:
                 with self._state_lock:
                     pending = list(pending_list)
                     pending_list.clear()
-                for fut in pending:
+                for fut, _raw in pending:
                     if not fut.done():
                         fut.set_exception(RuntimeError(
                             f"worker {self.rank} died "
                             f"(exitcode={proc.exitcode})"))
                 return
             with self._state_lock:
-                fut = pending_list.pop(0)
+                fut, raw = pending_list.pop(0)
             try:
                 status, payload = cloudpickle.loads(blob)
-                if status == "ok":
+                if raw:
+                    fut.set_result((status, payload))
+                elif status == "ok":
                     fut.set_result(cloudpickle.loads(payload))
                 else:
                     name, msg, tb = cloudpickle.loads(payload)
@@ -232,15 +242,36 @@ def _node_ip() -> str:
 
 class ActorPool:
     """N workers + fan-out helpers (the reference's actor list + fan-out loop,
-    ray_ddp.py:105,178-182)."""
+    ray_ddp.py:105,178-182).
+
+    ``agents``: HostAgent addresses ("host:port") for multi-machine pools --
+    workers become `agent.RemoteWorker`s spread in contiguous blocks over
+    the agents (the reference's multi-node actor placement,
+    ray_ddp.py:92-97).  None = local subprocesses."""
 
     def __init__(self, num_workers: int,
                  env_per_worker: Optional[Sequence[Dict[str, str]]] = None,
-                 init_hook: Optional[Callable[[], None]] = None):
+                 init_hook: Optional[Callable[[], None]] = None,
+                 agents: Optional[Sequence[str]] = None):
         envs = env_per_worker or [{} for _ in range(num_workers)]
         assert len(envs) == num_workers
-        ctx = mp.get_context("spawn")
-        self.workers = [Worker(i, envs[i], ctx) for i in range(num_workers)]
+        self.workers: List[Any] = []
+        try:
+            if agents:
+                from .agent import RemoteWorker, assign_agents
+                assignment = assign_agents(list(agents), num_workers)
+                for i in range(num_workers):
+                    self.workers.append(
+                        RemoteWorker(assignment[i], i, envs[i]))
+            else:
+                ctx = mp.get_context("spawn")
+                for i in range(num_workers):
+                    self.workers.append(Worker(i, envs[i], ctx))
+        except BaseException:
+            # one unreachable agent must not orphan the workers already
+            # spawned on the healthy ones
+            self.kill()
+            raise
         if init_hook is not None:
             for f in self.execute_all(init_hook):
                 f.result()

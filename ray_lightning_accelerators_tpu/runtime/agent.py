@@ -1,0 +1,405 @@
+"""Per-host worker agents: the multi-machine launch path.
+
+Capability analog of the reference's multi-NODE story -- Ray actors placed
+on remote cluster nodes with zero per-node setup (reference:
+README.md:57-62 ``ray up`` / ``ray submit``; ray_lightning/ray_ddp.py:92-97
+actor placement, :162-163 rank-0 rendezvous address).  Without Ray in the
+image this is a from-scratch control plane:
+
+- a **HostAgent** runs on every machine (``rla-tpu agent --port 7777``):
+  a TCP server that spawns one `runtime.actors.Worker` subprocess per
+  driver connection and relays cloudpickled work/results;
+- a driver-side **RemoteWorker** speaks that protocol behind the exact
+  interface of the local ``Worker`` (execute -> Future, restart, kill,
+  node_ip), so ``ActorPool`` mixes local and remote workers freely;
+- ``free_port``/``node_ip`` agent RPCs let the driver pick a
+  ``jax.distributed`` coordinator address on the rank-0 HOST (the
+  reference computed its tcp:// init string on the rank-0 actor,
+  ray_ddp.py:162-163).
+
+Wire protocol: 4-byte big-endian length prefix + cloudpickle payload.
+Driver -> agent: ``(req_id, op, payload)``; agent -> driver:
+``(req_id, status, payload)``.  ``execute`` replies when the worker
+finishes (the agent relays the worker's raw result bytes without
+deserializing them -- driver-only classes never unpickle on the agent).
+
+Security note: agents execute arbitrary pickled callables, exactly like a
+Ray worker does.  Bind them to trusted networks only.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ..utils.logging import log
+
+_LEN = struct.Struct(">I")
+DEFAULT_PORT = 7777
+
+
+# --------------------------------------------------------------------- #
+# Framing                                                                #
+# --------------------------------------------------------------------- #
+def send_msg(sock: socket.socket, obj) -> None:
+    blob = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def recv_msg(sock: socket.socket):
+    """Read one frame; raises ConnectionError on EOF mid-frame."""
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    return cloudpickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _node_ip() -> str:
+    return socket.gethostbyname(socket.gethostname())
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------------- #
+# Agent (server) side                                                    #
+# --------------------------------------------------------------------- #
+class HostAgent:
+    """One per machine.  Each accepted connection owns at most one worker
+    subprocess (the driver opens one connection per remote worker)."""
+
+    def __init__(self, port: int = DEFAULT_PORT, bind: str = "0.0.0.0"):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind, port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def serve_forever(self) -> None:
+        log.warning("rla-tpu agent listening on %s:%d", _node_ip(),
+                    self.port)
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                return  # socket closed by shutdown()
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(conn, addr), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------ #
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        from .actors import Worker
+
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        worker: Optional[Worker] = None
+        send_lock = threading.Lock()  # execute replies come from callbacks
+
+        def reply(req_id, status, payload) -> None:
+            try:
+                with send_lock:
+                    send_msg(conn, (req_id, status, payload))
+            except OSError:
+                pass  # driver went away; nothing to tell it
+
+        try:
+            while True:
+                try:
+                    req_id, op, payload = recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    if op == "spawn":
+                        rank, env = payload
+                        worker = Worker(rank, env)
+                        reply(req_id, "ok", None)
+                    elif op == "execute":
+                        fut = worker.execute_blob(payload, raw=True)
+
+                        def _done(f, req_id=req_id):
+                            e = f.exception()
+                            if e is not None:
+                                # worker died (never produced wire bytes)
+                                reply(req_id, "err", cloudpickle.dumps(
+                                    (type(e).__name__, str(e), "")))
+                            else:
+                                status, result_payload = f.result()
+                                # worker payloads are already pickled --
+                                # tag so the driver knows to loads() them
+                                reply(req_id,
+                                      "raw-ok" if status == "ok" else "err",
+                                      result_payload)
+
+                        fut.add_done_callback(_done)
+                    elif op == "alive":
+                        reply(req_id, "ok", worker is not None
+                              and worker.is_alive)
+                    elif op == "restart":
+                        worker.restart()
+                        reply(req_id, "ok", None)
+                    elif op == "kill":
+                        if worker is not None:
+                            worker.kill()
+                        reply(req_id, "ok", None)
+                    elif op == "worker_shutdown":
+                        if worker is not None:
+                            worker.shutdown()
+                            worker = None
+                        reply(req_id, "ok", None)
+                    elif op == "node_ip":
+                        reply(req_id, "ok", _node_ip())
+                    elif op == "free_port":
+                        reply(req_id, "ok", free_port())
+                    elif op == "ping":
+                        reply(req_id, "ok", "pong")
+                    else:
+                        reply(req_id, "err", cloudpickle.dumps(
+                            ("ValueError", f"unknown op {op!r}", "")))
+                except BaseException as e:  # never kill the conn loop
+                    reply(req_id, "err", cloudpickle.dumps(
+                        (type(e).__name__, str(e), "")))
+        finally:
+            if worker is not None:
+                worker.kill()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------- #
+# Driver side                                                            #
+# --------------------------------------------------------------------- #
+def parse_address(address: str) -> Tuple[str, int]:
+    host, _, port = address.partition(":")
+    return host, int(port) if port else DEFAULT_PORT
+
+
+class AgentConnection:
+    """A single multiplexed request/response connection to a HostAgent."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address
+        host, port = parse_address(address)
+        # retry while the agent boots: "start agents, then the driver" is
+        # the documented flow, and an agent importing jax takes seconds
+        import time as time_mod
+        deadline = time_mod.monotonic() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, port), timeout=timeout)
+                break
+            except ConnectionRefusedError:
+                if time_mod.monotonic() >= deadline:
+                    raise
+                time_mod.sleep(0.25)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self._closed = False
+        self._recv_thread = threading.Thread(target=self._recv_loop,
+                                             daemon=True)
+        self._recv_thread.start()
+
+    def request(self, op: str, payload=None) -> Future:
+        fut: Future = Future()
+        with self._state_lock:
+            if self._closed:
+                fut.set_exception(ConnectionError(
+                    f"agent {self.address} connection closed"))
+                return fut
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+        try:
+            with self._send_lock:
+                send_msg(self._sock, (req_id, op, payload))
+        except OSError as e:
+            with self._state_lock:
+                self._pending.pop(req_id, None)
+            if not fut.done():  # _recv_loop may have failed it concurrently
+                fut.set_exception(ConnectionError(
+                    f"agent {self.address} unreachable: {e}"))
+        return fut
+
+    def call(self, op: str, payload=None, timeout: float = 60.0):
+        return self.request(op, payload).result(timeout=timeout)
+
+    def _recv_loop(self) -> None:
+        from .actors import RemoteError
+
+        while True:
+            try:
+                req_id, status, payload = recv_msg(self._sock)
+            except (ConnectionError, OSError):
+                with self._state_lock:
+                    self._closed = True
+                    pending = list(self._pending.values())
+                    self._pending.clear()
+                for fut in pending:
+                    if not fut.done():
+                        fut.set_exception(ConnectionError(
+                            f"lost connection to agent {self.address}"))
+                return
+            with self._state_lock:
+                fut = self._pending.pop(req_id, None)
+            if fut is None or fut.done():
+                continue
+            try:
+                if status == "ok":
+                    fut.set_result(payload)
+                elif status == "raw-ok":
+                    fut.set_result(cloudpickle.loads(payload))
+                else:
+                    name, msg, tb = cloudpickle.loads(payload)
+                    fut.set_exception(RemoteError(name, msg, tb))
+            except BaseException as e:
+                fut.set_exception(RuntimeError(
+                    f"failed to deserialize result from agent "
+                    f"{self.address}: {type(e).__name__}: {e}"))
+
+    def close(self) -> None:
+        with self._state_lock:
+            self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteWorker:
+    """Driver-side handle to a worker subprocess on a remote HostAgent.
+
+    Interface-compatible with ``runtime.actors.Worker`` so ``ActorPool``
+    treats both uniformly."""
+
+    def __init__(self, address: str, rank: int,
+                 env: Optional[Dict[str, str]] = None):
+        self.rank = rank
+        self.address = address
+        self._env = dict(env or {})
+        self._conn = AgentConnection(address)
+        self._conn.call("spawn", (rank, self._env))
+
+    # -- Worker parity surface ---------------------------------------- #
+    def execute(self, fn, *args, **kwargs) -> Future:
+        # materialize driver-host object-store refs before shipping: the
+        # remote host cannot see this host's shared memory
+        from .object_store import ObjectRef, resolve
+        if any(isinstance(a, ObjectRef) for a in args) or \
+                any(isinstance(v, ObjectRef) for v in kwargs.values()):
+            args = tuple(resolve(a) for a in args)
+            kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        blob = cloudpickle.dumps((fn, args, kwargs))
+        return self._conn.request("execute", blob)
+
+    @property
+    def is_alive(self) -> bool:
+        try:
+            return bool(self._conn.call("alive", timeout=10))
+        except BaseException:
+            return False
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        return None if self.is_alive else -1
+
+    def restart(self) -> None:
+        self._conn.call("restart", timeout=60)
+
+    def set_env_var(self, key: str, value: str) -> Future:
+        return self.execute(_set_env_remote, key, value)
+
+    def get_node_ip(self) -> str:
+        return self._conn.call("node_ip")
+
+    def kill(self) -> None:
+        try:
+            self._conn.call("kill", timeout=10)
+        except BaseException:
+            pass
+        self._conn.close()
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        try:
+            self._conn.call("worker_shutdown", timeout=timeout)
+        except BaseException:
+            pass
+        self._conn.close()
+
+
+def _set_env_remote(key: str, value: str) -> None:
+    os.environ[key] = value
+
+
+# --------------------------------------------------------------------- #
+# Topology helpers                                                       #
+# --------------------------------------------------------------------- #
+def agents_from_env() -> Optional[List[str]]:
+    """Agent addresses from ``RLA_TPU_AGENTS`` (comma-separated), set by
+    ``rla-tpu launch`` or the user."""
+    raw = os.environ.get("RLA_TPU_AGENTS", "").strip()
+    return [a.strip() for a in raw.split(",") if a.strip()] or None
+
+
+def assign_agents(agents: Sequence[str], num_workers: int) -> List[str]:
+    """Contiguous block assignment: worker i's agent.  Blocks keep each
+    host's workers adjacent so global rank order groups by host (the
+    local-rank census stays meaningful, reference: ray_ddp.py:132-143)."""
+    n_agents = len(agents)
+    if num_workers % n_agents != 0:
+        raise ValueError(
+            f"num_workers={num_workers} must be divisible by the number "
+            f"of agents ({n_agents}) for an even per-host layout")
+    per = num_workers // n_agents
+    return [agents[i // per] for i in range(num_workers)]
+
+
+def coordinator_address_on(agent_address: str) -> str:
+    """Pick a jax.distributed coordinator address on the given agent's
+    host (rank-0 placement, reference setup_address analog,
+    ray_ddp.py:162-163)."""
+    conn = AgentConnection(agent_address)
+    try:
+        ip = conn.call("node_ip")
+        port = conn.call("free_port")
+        return f"{ip}:{port}"
+    finally:
+        conn.close()

@@ -11,15 +11,21 @@ flow: pick a coordinator address, fan a trainable out over actor workers
 with the right env, pump the trampoline queue, and return every rank's
 result (rank-0 first -- normalizing the result-tuple inconsistency SURVEY.md
 §3.2 flags between the reference's two accelerators).
+
+Multi-MACHINE launches pass ``agents`` -- per-host `runtime.agent.HostAgent`
+addresses (the reference's multi-node Ray cluster analog,
+reference: README.md:57-62).  The coordinator is then picked on agent[0]'s
+host (rank-0 placement, reference: ray_ddp.py:162-163), and the trampoline
+queue crosses the network through a `runtime.queue.QueueServer`.
 """
 
 from __future__ import annotations
 
 import socket
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .actors import ActorPool, RemoteError
-from .queue import TrampolineQueue, process_results
+from .queue import QueueServer, TrampolineQueue, process_results
 
 
 def pick_coordinator_address(port: Optional[int] = None) -> str:
@@ -57,10 +63,16 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
                        cpu_devices_per_process: Optional[int] = None,
                        env: Optional[Dict[str, str]] = None,
                        init_hook: Optional[Callable[[], None]] = None,
-                       queue: Optional[TrampolineQueue] = None) -> List[Any]:
+                       queue: Optional[TrampolineQueue] = None,
+                       agents: Optional[Sequence[str]] = None) -> List[Any]:
     """Fan `trainable(process_id)` over num_processes fresh processes, each
     with a jax.distributed world formed first.  Returns per-rank results,
     rank 0 first.
+
+    ``agents``: HostAgent addresses for a multi-machine launch (one worker
+    process per address slot, contiguous blocks).  With a ``queue``, every
+    worker gets a session whose trampoline reaches the driver over TCP, so
+    tune callbacks work unchanged through remote workers.
 
     The probe-then-close port pick in ``pick_coordinator_address`` has an
     inherent reuse window (another process can claim the freed port before
@@ -68,19 +80,39 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
     port rather than surfacing as an unattributable rendezvous hang.
     """
     for attempt in range(3):
-        coord = pick_coordinator_address()
+        if agents:
+            from .agent import coordinator_address_on
+            coord = coordinator_address_on(agents[0])
+        else:
+            coord = pick_coordinator_address()
 
-        def worker_body(process_id: int, coord=coord) -> Any:
+        qserver: Optional[QueueServer] = None
+        queue_address: Optional[str] = None
+        if queue is not None:
+            qserver = QueueServer(queue)
+            queue_address = qserver.address
+
+        def worker_body(process_id: int, coord=coord,
+                        queue_address=queue_address) -> Any:
             initialize_worker(coord, num_processes, process_id, platform,
                               cpu_devices_per_process)
+            if queue_address is not None:
+                from . import session as session_lib
+                from .queue import QueueClient
+                session_lib.init_session(process_id,
+                                         QueueClient(queue_address))
             if init_hook is not None:
                 init_hook()
             return trainable(process_id)
 
-        pool = ActorPool(num_processes,
-                         env_per_worker=[dict(env or {})
-                                         for _ in range(num_processes)])
+        pool: Optional[ActorPool] = None
         try:
+            # inside try: a partially-constructed multi-machine pool (one
+            # agent down) must still tear down the workers it DID spawn
+            pool = ActorPool(num_processes,
+                             env_per_worker=[dict(env or {})
+                                             for _ in range(num_processes)],
+                             agents=agents)
             futures = pool.execute_per_worker(
                 worker_body, [(i,) for i in range(num_processes)])
             return process_results(futures, queue)
@@ -93,7 +125,11 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
         except BaseException:
             # a crashed rank leaves its peers blocked in the distributed
             # barrier; they will never drain a shutdown sentinel -- kill
-            pool.kill()
+            if pool is not None:
+                pool.kill()
             raise
         finally:
-            pool.shutdown()
+            if qserver is not None:
+                qserver.close()
+            if pool is not None:
+                pool.shutdown()

@@ -44,6 +44,95 @@ class TrampolineQueue:
         pass
 
 
+class QueueServer:
+    """Driver-side TCP endpoint feeding a TrampolineQueue from workers in
+    OTHER processes/machines (the reference's queue was a Ray actor
+    reachable from any node, reference: util.py:22-68; this is the
+    no-Ray equivalent).  Each worker connects a QueueClient and streams
+    ``(rank, thunk)`` frames; a reader thread per connection deserializes
+    and enqueues locally."""
+
+    def __init__(self, queue: TrampolineQueue, bind: str = "0.0.0.0"):
+        import socket as socket_mod
+
+        from .agent import _node_ip, recv_msg
+
+        self._queue = queue
+        self._recv_msg = recv_msg
+        self._srv = socket_mod.socket(socket_mod.AF_INET,
+                                      socket_mod.SOCK_STREAM)
+        self._srv.setsockopt(socket_mod.SOL_SOCKET,
+                             socket_mod.SO_REUSEADDR, 1)
+        self._srv.bind((bind, 0))
+        self._srv.listen(128)
+        self.address = f"{_node_ip()}:{self._srv.getsockname()[1]}"
+        import threading
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        import threading
+        while True:
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn) -> None:
+        while True:
+            try:
+                item = self._recv_msg(conn)
+            except (ConnectionError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._queue.put(item)
+
+    def close(self) -> None:
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class QueueClient:
+    """Worker-side TrampolineQueue stand-in: ``put`` ships the thunk to
+    the driver's QueueServer over TCP.  Duck-typed to the queue interface
+    sessions use (put only -- workers never drain)."""
+
+    def __init__(self, address: str):
+        import socket as socket_mod
+        import threading
+
+        host, _, port = address.partition(":")
+        self._sock = socket_mod.create_connection((host, int(port)),
+                                                  timeout=30)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+
+    def put(self, item) -> None:
+        from .agent import send_msg
+        with self._lock:
+            send_msg(self._sock, item)
+
+    def empty(self) -> bool:
+        return True
+
+    def get_nowait(self):
+        return None
+
+    def shutdown(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def drain_queue(q: Optional[TrampolineQueue]) -> int:
     """Execute every queued callable in the driver process
     (reference: util.py:88-93)."""

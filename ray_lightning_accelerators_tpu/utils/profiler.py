@@ -78,6 +78,15 @@ class Profiler:
         self._lock = threading.Lock()
         self._trace_dir: Optional[str] = None
 
+    def __getstate__(self):
+        """Ship-able across processes (the Trainer fan-out pickles its
+        profiler): locks/thread-locals/stats stay behind -- a worker
+        starts its own clean profile."""
+        return {"sync": self.sync}
+
+    def __setstate__(self, state):
+        self.__init__(sync=state["sync"])
+
     # ------------------------------------------------------------------ #
     def _stack(self) -> List[str]:
         if not hasattr(self._tls, "stack"):

@@ -12,4 +12,7 @@ setup(
                 "hyperparameter search subsystem",
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "numpy"],
+    entry_points={
+        "console_scripts": ["rla-tpu=ray_lightning_accelerators_tpu.cli:main"],
+    },
 )

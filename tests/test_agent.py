@@ -1,0 +1,313 @@
+"""Multi-machine launch path: per-host agents + RemoteWorkers.
+
+Behavioral analog of the reference's multi-node capability (reference:
+README.md:57-62 -- cluster fan-out; ray_lightning/ray_ddp.py:92-97 actor
+placement on remote nodes; tests/test_ddp_gpu.py:106-117 the opt-in
+multi-node test).  Two HostAgents on localhost stand in for two machines:
+every byte between driver and worker crosses a real TCP socket, so the
+same code path serves genuinely remote hosts.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.runtime.actors import (ActorPool,
+                                                           RemoteError)
+from ray_lightning_accelerators_tpu.runtime.agent import (HostAgent,
+                                                          RemoteWorker,
+                                                          assign_agents,
+                                                          coordinator_address_on)
+from ray_lightning_accelerators_tpu.runtime.queue import (QueueClient,
+                                                          QueueServer,
+                                                          TrampolineQueue)
+
+
+@pytest.fixture()
+def two_agents():
+    agents = [HostAgent(port=0, bind="127.0.0.1") for _ in range(2)]
+    for a in agents:
+        a.serve_in_background()
+    yield [f"127.0.0.1:{a.port}" for a in agents]
+    for a in agents:
+        a.shutdown()
+
+
+def _pid():
+    return os.getpid()
+
+
+def _sq(x):
+    return x * x
+
+
+def _getenv(k):
+    return os.environ.get(k)
+
+
+def _boom():
+    raise ValueError("remote worker exploded")
+
+
+def _die():
+    os._exit(13)
+
+
+def test_remote_worker_executes(two_agents):
+    w = RemoteWorker(two_agents[0], rank=0, env={"RLA_AGENT_T": "x"})
+    try:
+        assert w.execute(_sq, 6).result(timeout=60) == 36
+        assert w.execute(_getenv, "RLA_AGENT_T").result(timeout=60) == "x"
+        assert w.is_alive
+        assert w.get_node_ip()  # resolves without error
+    finally:
+        w.shutdown()
+
+
+def test_remote_error_carries_traceback(two_agents):
+    w = RemoteWorker(two_agents[0], rank=0)
+    try:
+        with pytest.raises(RemoteError, match="remote worker exploded"):
+            w.execute(_boom).result(timeout=60)
+        # the worker survives an exception and keeps serving
+        assert w.execute(_sq, 3).result(timeout=60) == 9
+    finally:
+        w.shutdown()
+
+
+def test_remote_worker_death_fails_future_and_restarts(two_agents):
+    w = RemoteWorker(two_agents[0], rank=0)
+    try:
+        with pytest.raises(RuntimeError, match="died"):
+            w.execute(_die).result(timeout=60)
+        deadline = time.time() + 10
+        while w.is_alive and time.time() < deadline:
+            time.sleep(0.05)
+        assert not w.is_alive
+        w.restart()
+        assert w.execute(_sq, 4).result(timeout=60) == 16
+    finally:
+        w.shutdown()
+
+
+def test_pool_over_agents_places_block_per_agent(two_agents):
+    with ActorPool(4, agents=two_agents) as pool:
+        pids = [f.result(timeout=60) for f in pool.execute_all(_pid)]
+        assert len(set(pids)) == 4
+        assert all(p != os.getpid() for p in pids)
+        # contiguous block assignment: workers 0,1 -> agent 0; 2,3 -> agent 1
+        addrs = [w.address for w in pool.workers]
+        assert addrs == [two_agents[0], two_agents[0],
+                         two_agents[1], two_agents[1]]
+        assert pool.local_ranks() == [0, 1, 2, 3]  # same IP on localhost
+
+
+def test_assign_agents_requires_even_split():
+    with pytest.raises(ValueError, match="divisible"):
+        assign_agents(["a:1", "b:2"], 3)
+
+
+def test_coordinator_address_on_agent_host(two_agents):
+    coord = coordinator_address_on(two_agents[0])
+    host, port = coord.rsplit(":", 1)
+    assert host and 0 < int(port) < 65536
+
+
+def _remote_mark():
+    # module-global so the cloudpickled thunk resolves it by reference in
+    # the receiving process (a closed-over local would arrive as a copy)
+    _SEEN.append("remote")
+
+
+def test_queue_crosses_the_network():
+    q = TrampolineQueue()
+    server = QueueServer(q)
+    _SEEN.clear()
+    try:
+        client = QueueClient(server.address)
+        client.put((3, _remote_mark))
+        deadline = time.time() + 10
+        while q.empty() and time.time() < deadline:
+            time.sleep(0.01)
+        rank, thunk = q.get_nowait()
+        thunk()
+        assert rank == 3 and _SEEN == ["remote"]
+        client.shutdown()
+    finally:
+        server.close()
+
+
+def test_pool_env_and_health_over_agents(two_agents):
+    with ActorPool(2, env_per_worker=[{"RLA_HOSTV": "h0"},
+                                      {"RLA_HOSTV": "h1"}],
+                   agents=two_agents) as pool:
+        vals = [f.result(timeout=60)
+                for f in pool.execute_all(_getenv, "RLA_HOSTV")]
+        assert vals == ["h0", "h1"]
+        assert pool.health_check() == [True, True]
+
+
+# ------------------------------------------------------------------ #
+# End-to-end distributed launches through agents (slow)               #
+# ------------------------------------------------------------------ #
+def _distributed_psum_agent(process_id):
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 2
+    out = jax.shard_map(
+        lambda x: jax.lax.psum(x, "i"),
+        mesh=jax.sharding.Mesh(jax.devices(), ("i",)),
+        in_specs=jax.sharding.PartitionSpec("i"),
+        out_specs=jax.sharding.PartitionSpec())(jnp.arange(2.0))
+    return float(np.asarray(out)[0])
+
+
+@pytest.mark.slow
+def test_launch_distributed_through_agents(two_agents):
+    """launch_distributed(agents=...) forms a REAL 2-process
+    jax.distributed world with one worker per 'host'."""
+    from ray_lightning_accelerators_tpu.runtime.bootstrap import (
+        launch_distributed)
+
+    results = launch_distributed(
+        _distributed_psum_agent, num_processes=2, platform="cpu",
+        cpu_devices_per_process=1,
+        env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""},
+        agents=two_agents)
+    assert results == [1.0, 1.0]
+
+
+def _distributed_fit_agent(process_id):
+    import jax
+    import numpy as np
+    from ray_lightning_accelerators_tpu import DataLoader, Trainer
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.runtime import session as session_lib
+    from tests.utils import BoringModel
+
+    # device-binding contract (the reference pins the device/env mapping,
+    # reference: tests/test_ddp_gpu.py:89-95): each process sees exactly
+    # its devices, and the global view spans both processes
+    assert len(jax.local_devices()) == 2
+    assert jax.device_count() == 4
+    assert jax.process_index() == process_id
+
+    # the trampoline session reaches the driver over the network; a
+    # partial of a module-level function pickles BY REFERENCE, so the
+    # executed thunk mutates the DRIVER's module globals (a lambda would
+    # pickle by value and mutate a copy)
+    import functools
+    session_lib.put_queue(functools.partial(_mark_rank, process_id))
+
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype("float32")
+    model = BoringModel()
+    trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=f"/tmp/agent_fit_{process_id}")
+    trainer.fit(model, DataLoader(ArrayDataset(x), batch_size=8))
+    leaf = np.asarray(jax.tree.leaves(model.params)[0], dtype=np.float64)
+    return (trainer.global_step, float(leaf.sum()),
+            float(trainer.callback_metrics["loss"]))
+
+
+_SEEN: list = []  # driver-side sink for trampolined thunks
+
+
+def _mark_rank(pid):
+    _SEEN.append(pid)
+
+
+@pytest.mark.slow
+def test_full_fit_through_agents(two_agents):
+    """A complete Trainer.fit across two agent-hosted processes: sampler
+    shards per process, gradient psum crosses the (local) network, both
+    ranks agree on steps and final weights, and worker thunks reach the
+    driver queue."""
+    from ray_lightning_accelerators_tpu.runtime.bootstrap import (
+        launch_distributed)
+
+    _SEEN.clear()
+    q = TrampolineQueue()
+    results = launch_distributed(
+        _distributed_fit_agent, num_processes=2, platform="cpu",
+        cpu_devices_per_process=2,
+        env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""},
+        agents=two_agents, queue=q)
+    steps0, wsum0, loss0 = results[0]
+    steps1, wsum1, loss1 = results[1]
+    assert steps0 == steps1 == 8  # 64 / 2 replicas / batch 8 x 2 epochs
+    assert wsum0 == pytest.approx(wsum1, rel=1e-6)
+    assert loss0 == pytest.approx(loss1, rel=1e-5)
+    assert sorted(_SEEN) == [0, 1]  # one thunk per rank reached the driver
+
+
+def _worker_topology_probe(process_id):
+    """Inside a 2-process world, a mismatched num_hosts must raise."""
+    from ray_lightning_accelerators_tpu import (HorovodRayAccelerator,
+                                                Trainer, DataLoader)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from tests.utils import BoringModel
+    import numpy as np
+    import pytest as pt
+
+    x = np.zeros((16, 32), dtype="float32")
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      accelerator=HorovodRayAccelerator(num_hosts=3,
+                                                        num_slots=1),
+                      default_root_dir=f"/tmp/topo_probe_{process_id}")
+    with pt.raises(ValueError, match="num_hosts=3"):
+        trainer.fit(BoringModel(),
+                    DataLoader(ArrayDataset(x), batch_size=8))
+    return "raised"
+
+
+@pytest.mark.slow
+def test_num_hosts_mismatch_raises_in_distributed_world(two_agents):
+    from ray_lightning_accelerators_tpu.runtime.bootstrap import (
+        launch_distributed)
+
+    results = launch_distributed(
+        _worker_topology_probe, num_processes=2, platform="cpu",
+        cpu_devices_per_process=1,
+        env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+             "RLA_TPU_INSIDE_WORKER": "1"},
+        agents=two_agents)
+    assert results == ["raised", "raised"]
+
+
+@pytest.mark.slow
+def test_driver_mode_fit_through_agents(two_agents, tmp_path):
+    """The reference's headline flow, multi-machine: the DRIVER calls
+    trainer.fit once; the framework fans out one process per host agent,
+    trains SPMD across them, and re-hydrates rank-0 weights + metrics into
+    the driver's module (reference: ray_lightning/ray_ddp.py:169-193)."""
+    import numpy as np
+    from ray_lightning_accelerators_tpu import (HorovodRayAccelerator,
+                                                Trainer, DataLoader)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from tests.utils import BoringModel
+
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype("float32")
+    model = BoringModel()
+    assert model.params is None
+    trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      accelerator=HorovodRayAccelerator(
+                          num_hosts=2, num_slots=2, agents=two_agents),
+                      default_root_dir=str(tmp_path))
+    trainer.fit(model, DataLoader(ArrayDataset(x), batch_size=8))
+
+    # rank-0 state re-hydrated into the driver's objects
+    assert trainer.global_step == 8  # 64 / 2 procs / batch 8 x 2 epochs
+    assert trainer.epochs_completed == 2
+    assert "loss" in trainer.callback_metrics
+    assert model.params is not None
+    # weights really trained: loss at re-hydrated params beats init,
+    # and the model is directly usable driver-side
+    out = np.asarray(model.forward(model.params, x[:4]))
+    assert out.shape == (4, 2)
+    assert float(np.mean((out - 1.0) ** 2)) < 1.0  # moved toward target
