@@ -1,30 +1,79 @@
-"""Benchmark: MNIST classifier training throughput through the full framework.
+"""Benchmarks through the full framework.  One JSON line per metric:
+{"metric", "value", "unit", "vs_baseline", ...}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- ``mnist``  (headline, BASELINE.json north star): imgs/sec/chip training
+  the MNISTClassifier example end-to-end through Trainer +
+  RayTPUAccelerator.  Baseline constant: 25_000 imgs/sec -- a single-A100
+  PTL+DDP run of this 3-layer-MLP example is input-pipeline-bound in that
+  regime (BASELINE.json target: ">= single-A100 DDP throughput").
+- ``gpt``    (flagship compute bench): tokens/sec/chip + MFU training a
+  GPT-2-small-class model (124M params, seq 1024, bf16, fused LM-head
+  loss, flash attention).  FLOPs/token uses the PaLM-appendix formula
+  6*N + 12*L*d_model*S (matmul params + attention); peak FLOP/s comes
+  from utils.profiler.mfu's per-chip table (v5e-class: 197 TFLOP/s
+  bf16).  vs_baseline is MFU against the 0.35 driver bar.
+- ``cifar``  (BASELINE.md config #3, single-chip): ResNet18 imgs/sec/chip
+  + val_acc.
 
-Metric matches BASELINE.json's north star (MNIST imgs/sec/chip; the reference
-publishes no numbers, BASELINE.md): images/sec/chip training the
-MNISTClassifier example end-to-end through Trainer + RayTPUAccelerator on the
-default backend (the real TPU chip under the driver; CPU fallback keeps the
-script runnable anywhere).  The timed region is epochs 2..N of a single
-public-API ``fit`` — epoch 1 absorbs compile + the one-time device-cache
-shipment, the steady-state epochs measure the training loop the way a user
-runs it (device-resident gather feeding a donated, jitted train step).
+Each timed region is the steady state of a single public-API ``fit`` --
+epoch 1 absorbs compile + the one-time device-cache shipment, later epochs
+measure the loop the way a user runs it (device-resident gather feeding a
+donated, jitted train step).
 
-Baseline constant: 25_000 imgs/sec — a single-A100 PTL+DDP run of this
-3-layer-MLP example is input-pipeline-bound in that regime (BASELINE.json
-target: ">= single-A100 DDP throughput").
+The reference publishes no numbers anywhere (BASELINE.md); baselines here
+are the driver-defined bars.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
 
-BASELINE_IMGS_PER_SEC = 25_000.0
+BASELINE_MNIST_IMGS_PER_SEC = 25_000.0
+GPT_MFU_TARGET = 0.35
+BASELINE_CIFAR_IMGS_PER_SEC = 2_500.0  # single-A100 PTL+DDP ResNet18/CIFAR
 
 
-def main() -> None:
+class _EpochClock:
+    """Wall time at train-epoch boundaries, honestly device-synced.
+
+    The sync is a 4-byte host readback of the step counter -- the scalar
+    is produced by the epoch's last dispatched step, so reading it drains
+    the device queue.  (``block_until_ready`` is NOT trusted here: through
+    a tunneled PjRt client it can return before the device work ran.)
+    Marks at epoch start AND end keep the timed window free of fit()'s
+    final full-parameter download."""
+
+    def __init__(self, base):
+        import jax
+        import numpy as np
+
+        class _CB(base):
+            def __init__(cb_self):
+                cb_self.starts = []
+                cb_self.ends = []
+
+            def _sync(cb_self, trainer):
+                if trainer._state is not None:
+                    int(np.asarray(jax.device_get(trainer._state.step)))
+                return time.perf_counter()
+
+            def on_train_epoch_start(cb_self, trainer, module):
+                cb_self.starts.append(cb_self._sync(trainer))
+
+            def on_train_epoch_end(cb_self, trainer, module):
+                cb_self.ends.append(cb_self._sync(trainer))
+
+        self.cb = _CB()
+
+    def steady_state_seconds(self) -> float:
+        """Epoch-2-start .. last-epoch-end (epoch 1 absorbs compile)."""
+        return self.cb.ends[-1] - self.cb.starts[1]
+
+
+def bench_mnist() -> dict:
     import jax
 
     from ray_lightning_accelerators_tpu import (Callback, DataLoader,
@@ -32,23 +81,6 @@ def main() -> None:
     from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
     from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
                                                              synthetic_mnist)
-
-    class EpochClock(Callback):
-        """Wall time at each train-epoch boundary (device-synced)."""
-
-        def __init__(self):
-            self.marks = []
-
-        def _mark(self, trainer):
-            if trainer._state is not None:
-                jax.block_until_ready(trainer._state.params)
-            self.marks.append(time.perf_counter())
-
-        def on_train_epoch_start(self, trainer, module):
-            self._mark(trainer)
-
-        def on_fit_end(self, trainer, module):
-            self._mark(trainer)
 
     n_devices = jax.device_count()
     batch_size = 1024 * n_devices
@@ -59,25 +91,158 @@ def main() -> None:
 
     model = MNISTClassifier({"layer_1": 128, "layer_2": 256, "lr": 1e-3,
                              "batch_size": batch_size})
-    clock = EpochClock()
+    clock = _EpochClock(Callback)
     epochs = 5
     trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
                       precision="bf16", enable_checkpointing=False,
-                      log_every_n_steps=10 ** 9, seed=0, callbacks=[clock],
+                      log_every_n_steps=10 ** 9, seed=0,
+                      callbacks=[clock.cb],
                       default_root_dir="/tmp/rla_tpu_bench")
     trainer.fit(model, loader)
 
-    # steady state: epochs 2..N (epoch 1 paid compile + cache shipment)
     steps_per_epoch = len(loader)
-    dt = clock.marks[-1] - clock.marks[1]
+    dt = clock.steady_state_seconds()
     imgs = batch_size * steps_per_epoch * (epochs - 1)
     per_chip = imgs / dt / n_devices
-    print(json.dumps({
+    return {
         "metric": "mnist_mlp_train_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "imgs/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC, 3),
-    }))
+        "vs_baseline": round(per_chip / BASELINE_MNIST_IMGS_PER_SEC, 3),
+    }
+
+
+def bench_gpt() -> dict:
+    import jax
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
+                                                RayTPUAccelerator, Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    from ray_lightning_accelerators_tpu.utils import profiler as prof
+
+    n_devices = jax.device_count()
+    seq = 1024
+    per_chip_batch = 16
+    batch = per_chip_batch * n_devices
+    cfg = TransformerConfig(vocab_size=50304, d_model=768, n_heads=12,
+                            d_ff=3072, n_layers=12, max_seq_len=seq,
+                            fused_loss=True, loss_chunk_rows=4096)
+    model = GPT(cfg, lr=3e-4)
+
+    steps_per_epoch = 12
+    n_seqs = batch * steps_per_epoch
+    tokens = np.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                          size=(n_seqs, seq)),
+        dtype=np.int32)
+    loader = DataLoader(ArrayDataset(tokens), batch_size=batch,
+                        shuffle=False)
+
+    clock = _EpochClock(Callback)
+    epochs = 3
+    trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
+                      precision="bf16", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0,
+                      callbacks=[clock.cb],
+                      default_root_dir="/tmp/rla_tpu_bench_gpt")
+    trainer.fit(model, loader)
+
+    dt = clock.steady_state_seconds()
+    timed_steps = steps_per_epoch * (epochs - 1)
+    tokens_done = batch * seq * timed_steps
+    tok_per_sec_chip = tokens_done / dt / n_devices
+    step_time = dt / timed_steps
+
+    # PaLM-appendix train FLOPs: 6*N per matmul param-touch (fwd + 2x bwd)
+    # + 12*L*d_model*S attention per token.  N counts matmul params (norm
+    # scales are negligible; the tied embedding is counted once, covering
+    # the unembedding matmul).
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(model.params))
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    flops_per_step = flops_per_token * batch * seq
+    mfu = prof.mfu(flops_per_step / n_devices, step_time)
+    return {
+        "metric": "gpt2s_124m_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "mfu": round(mfu, 4),
+        "params": n_params,
+        "seq_len": seq,
+        "peak_flops_note": "per-chip bf16 peak from device_kind "
+                           "(v5e-class 197e12)",
+        "vs_baseline": round(mfu / GPT_MFU_TARGET, 3),
+    }
+
+
+def bench_cifar() -> dict:
+    import jax
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
+                                                RayTPUAccelerator, Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.models.resnet import (
+        CIFAR10DataModule, ResNet18)
+
+    n_devices = jax.device_count()
+    batch = 256 * n_devices
+    dm = CIFAR10DataModule(batch_size=batch, n_train=batch * 12,
+                           n_val=batch * 2)
+    dm.setup("fit")
+
+    model = ResNet18({"lr": 0.05, "batch_size": batch})
+    clock = _EpochClock(Callback)
+    epochs = 4
+    trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
+                      precision="bf16", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0,
+                      callbacks=[clock.cb],
+                      default_root_dir="/tmp/rla_tpu_bench_cifar")
+    # train-only fit so the timed window holds pure training steps;
+    # validation runs once afterwards for the accuracy gate
+    train_loader = dm.train_dataloader()
+    trainer.fit(model, train_loader)
+    steps_per_epoch = len(train_loader)
+    dt = clock.steady_state_seconds()
+    imgs = batch * steps_per_epoch * (epochs - 1)
+    per_chip = imgs / dt / n_devices
+    val_metrics = trainer.validate(model, dm.val_dataloader())[0]
+    val_acc = float(val_metrics.get("val_accuracy", 0.0))
+    return {
+        "metric": "cifar_resnet18_train_imgs_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "imgs/sec/chip",
+        "val_acc": round(val_acc, 4),
+        # CIFAR10DataModule.source: "real" when local CIFAR-10 binaries
+        # were found, "synthetic" otherwise
+        "data": getattr(dm, "source", "synthetic"),
+        "vs_baseline": round(per_chip / BASELINE_CIFAR_IMGS_PER_SEC, 3),
+    }
+
+
+BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--benches", default="mnist,gpt,cifar",
+                        help="comma-separated subset of "
+                             f"{sorted(BENCHES)}")
+    args = parser.parse_args()
+    failed = False
+    for name in [b.strip() for b in args.benches.split(",") if b.strip()]:
+        try:
+            print(json.dumps(BENCHES[name]()), flush=True)
+        except Exception as e:  # emit remaining benches; Ctrl-C still aborts
+            failed = True
+            print(f"bench {name} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -4,14 +4,17 @@ The reference has no attention op (it delegates all compute to the user's
 torch model); this framework ships transformer models, and attention is the
 hot op, so it gets a hand-written TPU kernel:
 
-- online-softmax flash attention tiled for the MXU (128-aligned q/kv blocks),
-  running max/sum carried in VMEM scratch across the kv grid dimension;
+- online-softmax flash attention with large (512) q/kv blocks -- attention
+  at transformer shapes is HBM-traffic-bound, so fewer k/v reloads beat
+  MXU-sized 128 tiles; bf16 operands feed the MXU directly with f32
+  accumulation, and the forward also emits per-row log-sum-exp for the
+  backward;
 - causal masking with whole-block skipping (blocks strictly above the
   diagonal do no MXU work);
-- backward pass via ``jax.custom_vjp`` recomputation in XLA (flash-style: no
-  S x S materialization held as residuals -- memory stays O(S*D); XLA fuses
-  the recompute well).  A hand-written backward kernel is a later
-  optimization slot.
+- hand-written backward kernels (``jax.custom_vjp``): a dq pass and a
+  dk/dv pass recompute score blocks from q/k and the saved lse in
+  TRANSPOSED [block_k, block_q] space (per-query rows broadcast along
+  lanes), never materializing [S, S] in HBM.
 
 On non-TPU backends (tests on the virtual CPU mesh), dispatch falls back to
 a reference jnp implementation with identical semantics.
@@ -62,7 +65,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 # --------------------------------------------------------------------- #
 # Pallas kernel                                                         #
 # --------------------------------------------------------------------- #
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   window: Optional[int]):
     qi = pl.program_id(1)
@@ -85,10 +89,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [block_q, d]
-        k = k_ref[0].astype(jnp.float32)            # [block_k, d]
+        # bf16 operands straight into the MXU with an f32 accumulator --
+        # casting to f32 first would halve MXU throughput for no accuracy
+        # gain (the accumulate is f32 either way)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
         if causal or window is not None:
             rows = jax.lax.broadcasted_iota(jnp.int32,
@@ -118,12 +123,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows -> 0
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # log-sum-exp per query row, for the backward recompute (the
+        # transpose moves [block_q, 1] sublanes onto lanes once per block)
+        lse = m_scr[:, :1] + jnp.log(l)
+        lse_ref[...] = jnp.transpose(lse, (1, 0))[None]
 
 
 def _flash_forward(q3: jax.Array, k3: jax.Array, v3: jax.Array, scale: float,
                    causal: bool, block_q: int, block_k: int,
-                   interpret: bool, window: Optional[int] = None) -> jax.Array:
-    """q3,k3,v3: [bh, seq, d] (batch*heads folded)."""
+                   interpret: bool, window: Optional[int] = None):
+    """q3,k3,v3: [bh, seq, d] (batch*heads folded).
+    Returns (out [bh, seq, d], lse [bh, 1, seq] f32)."""
     bh, q_len, d = q3.shape
     k_len = k3.shape[1]
     grid = (bh, q_len // block_q, k_len // block_k)
@@ -138,64 +148,268 @@ def _flash_forward(q3: jax.Array, k3: jax.Array, v3: jax.Array, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # [bh, 1, q_len]: the middle singleton keeps the block's
+            # second-to-last dim equal to the array's (TPU lowering
+            # constraint on 2D row vectors)
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, q_len), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
         ],
+        compiler_params=pltpu.CompilerParams(
+            # bh and q blocks are independent; only the kv walk carries
+            # the online-softmax state
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
 
 
-def _use_pallas(q: jax.Array, block_q: int, block_k: int) -> bool:
+# --------------------------------------------------------------------- #
+# Backward kernels                                                       #
+# --------------------------------------------------------------------- #
+# Flash-style backward: recompute the score block from q/k and the saved
+# per-row log-sum-exp, never materializing [S, S] in HBM.  Both kernels
+# work in the TRANSPOSED score space [block_k, block_q] so the per-QUERY
+# lse/delta rows broadcast along lanes ([1, block_q]) -- no sublane
+# broadcasts or in-kernel transposes in the hot loop.
+#
+#   dP  = dO @ V^T          dS = P * (dP - delta) * scale
+#   dQ  = dS @ K            dK = dS^T @ Q           dV = P^T @ dO
+#   delta_i = sum_d dO_id * O_id     P = exp(S - lse)
+
+def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, qi, ki, *,
+               scale, causal, block_q, block_k, window):
+    """Shared recompute: returns (pT [bk,bq] f32, dsT [bk,bq] f32)."""
+    sT = jax.lax.dot_general(
+        k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [bk, bq]
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1)
+    if causal or window is not None:
+        mask = qpos >= kpos
+        if window is not None:
+            mask &= (qpos - kpos) < window
+        sT = jnp.where(mask, sT, _NEG_INF)
+    pT = jnp.exp(sT - lse_ref[0])                        # [bk, bq]
+    dpT = jax.lax.dot_general(
+        v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [bk, bq]
+    dsT = pT * (dpT - dta_ref[0]) * scale
+    return pT, dsT
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                         dq_ref, dq_scr, *, scale, causal, block_q,
+                         block_k, window):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    last_k = pl.num_programs(2) - 1
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+    if window is not None:
+        needed = needed & (ki * block_k + block_k - 1
+                           >= qi * block_q - window + 1)
+
+    @pl.when(needed)
+    def _compute():
+        _, dsT = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                            qi, ki, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k, window=window)
+        # dQ[bq, d] += dsT^T @ K == contract dsT dim0 with K dim0
+        dq_scr[:] += jax.lax.dot_general(
+            dsT.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_k)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                          block_q, block_k, window):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    last_q = pl.num_programs(2) - 1
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+    if window is not None:
+        needed = needed & (ki * block_k + block_k - 1
+                           >= qi * block_q - window + 1)
+
+    @pl.when(needed)
+    def _compute():
+        pT, dsT = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                             qi, ki, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k, window=window)
+        dv_scr[:] += jax.lax.dot_general(
+            pT.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            dsT.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == last_q)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q3, k3, v3, o3, lse, g3, scale, causal, block_q,
+                    block_k, interpret, window=None):
+    """dq, dk, dv for folded [bh, seq, d] operands."""
+    bh, q_len, d = q3.shape
+    k_len = k3.shape[1]
+    # delta_i = rowsum(dO * O): tiny elementwise pass in XLA
+    delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]                   # [bh, 1, q_len]
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, window=window)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, q_len // block_q, k_len // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+    # dkv walks q inside k: swap the roles of the two inner grid dims
+    qspec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rowspec_t = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(bh, k_len // block_k, q_len // block_q),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[jax.ShapeDtypeStruct((bh, k_len, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, k_len, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+    return dq, dk, dv
+
+
+def _pick_block(requested: int, length: int) -> Optional[int]:
+    """Largest 128-multiple block <= requested that divides ``length``
+    (TPU tiles need 128-aligned blocks; unaligned lengths fall back).
+    None when no such block exists."""
+    best = None
+    for cand in range(128, min(requested, length) + 1, 128):
+        if length % cand == 0:
+            best = cand
+    return best
+
+
+def _use_pallas(q: jax.Array, block_q: Optional[int],
+                block_k: Optional[int]) -> bool:
     if os.environ.get("RLA_TPU_DISABLE_PALLAS"):
         return False
     if jax.default_backend() not in ("tpu", "axon"):
         return False
-    *_, q_len, d = q.shape
-    return q_len % block_q == 0 and q.shape[-2] % block_k == 0 and d >= 64
+    d = q.shape[-1]
+    # below one MXU-sized q block the launch overhead beats any tiling win;
+    # XLA handles short sequences fine
+    return block_q is not None and block_k is not None and d >= 64
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     window: Optional[int] = None) -> jax.Array:
     """Fused attention.  q,k,v: [batch, heads, seq, head_dim].
 
     Uses the Pallas TPU kernel when shapes allow, XLA reference otherwise.
     ``window`` enables sliding-window causal attention (see
     attention_reference).
+
+    Default blocks are 512x512: attention at transformer shapes is
+    HBM-traffic-bound (k/v reload once per q block), so fewer, larger q
+    blocks beat MXU-sized 128 tiles; 512 keeps the f32 score block at
+    1 MB, small enough for double-buffered VMEM.
     """
     b, h, q_len, d = q.shape
     scale_v = scale if scale is not None else d ** -0.5
+    # effective blocks: the largest 128-aligned divisors of the extents,
+    # so e.g. seq 640 tiles as 128-blocks instead of losing the kernel
+    block_q = _pick_block(block_q, q_len)
+    block_k = _pick_block(block_k, k.shape[2])
     if not _use_pallas(q, block_q, block_k):
         return attention_reference(q, k, v, causal=causal, scale=scale_v,
                                    window=window)
     q3 = q.reshape(b * h, q_len, d)
     k3 = k.reshape(b * h, k.shape[2], d)
     v3 = v.reshape(b * h, v.shape[2], d)
-    out = _flash_forward(q3, k3, v3, scale_v, causal,
-                         min(block_q, q_len), min(block_k, k.shape[2]),
-                         interpret=False, window=window)
+    out, _ = _flash_forward(q3, k3, v3, scale_v, causal, block_q, block_k,
+                            interpret=False, window=window)
     return out.reshape(b, h, q_len, d)
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, window):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, window)
-    return out, (q, k, v)
+    b, h, q_len, d = q.shape
+    scale_v = scale if scale is not None else d ** -0.5
+    eff_q = _pick_block(block_q, q_len)
+    eff_k = _pick_block(block_k, k.shape[2])
+    if not _use_pallas(q, eff_q, eff_k):
+        out = attention_reference(q, k, v, causal=causal, scale=scale_v,
+                                  window=window)
+        return out, (q, k, v, None, None)
+    q3 = q.reshape(b * h, q_len, d)
+    k3 = k.reshape(b * h, k.shape[2], d)
+    v3 = v.reshape(b * h, v.shape[2], d)
+    out3, lse = _flash_forward(q3, k3, v3, scale_v, causal, eff_q, eff_k,
+                               interpret=False, window=window)
+    return out3.reshape(b, h, q_len, d), (q, k, v, out3, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, window, residuals, g):
-    q, k, v = residuals
-    # flash-style recompute: grads of the reference formulation, fused by XLA
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
-                                               scale=scale, window=window),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o3, lse = residuals
+    b, h, q_len, d = q.shape
+    scale_v = scale if scale is not None else d ** -0.5
+    if o3 is None:
+        # reference forward path: grads of the reference formulation
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
+                                                   scale=scale_v,
+                                                   window=window),
+            q, k, v)
+        return vjp(g)
+    q3 = q.reshape(b * h, q_len, d)
+    k3 = k.reshape(b * h, k.shape[2], d)
+    v3 = v.reshape(b * h, v.shape[2], d)
+    g3 = g.reshape(b * h, q_len, d)
+    dq, dk, dv = _flash_backward(q3, k3, v3, o3, lse, g3, scale_v, causal,
+                                 _pick_block(block_q, q_len),
+                                 _pick_block(block_k, k.shape[2]),
+                                 interpret=False, window=window)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -209,6 +423,24 @@ def flash_attention_interpret(q, k, v, causal=False, scale=None,
     q3 = q.reshape(b * h, q_len, d)
     k3 = k.reshape(b * h, k.shape[2], d)
     v3 = v.reshape(b * h, v.shape[2], d)
-    out = _flash_forward(q3, k3, v3, scale_v, causal, block_q, block_k,
-                         interpret=True, window=window)
+    out, _ = _flash_forward(q3, k3, v3, scale_v, causal, block_q, block_k,
+                            interpret=True, window=window)
     return out.reshape(b, h, q_len, d)
+
+
+def flash_attention_grads_interpret(q, k, v, g, causal=False, scale=None,
+                                    block_q=128, block_k=128, window=None):
+    """Interpreter-mode backward-kernel entry (CPU correctness tests):
+    returns (dq, dk, dv) for cotangent ``g``."""
+    b, h, q_len, d = q.shape
+    scale_v = scale if scale is not None else d ** -0.5
+    q3 = q.reshape(b * h, q_len, d)
+    k3 = k.reshape(b * h, k.shape[2], d)
+    v3 = v.reshape(b * h, v.shape[2], d)
+    g3 = g.reshape(b * h, q_len, d)
+    out3, lse = _flash_forward(q3, k3, v3, scale_v, causal, block_q,
+                               block_k, interpret=True, window=window)
+    dq, dk, dv = _flash_backward(q3, k3, v3, out3, lse, g3, scale_v,
+                                 causal, block_q, block_k, interpret=True,
+                                 window=window)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))

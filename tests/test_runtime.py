@@ -159,6 +159,15 @@ def _distributed_fit(process_id, coord, nprocs):
                       cpu_devices_per_process=2)
     import jax
     import numpy as np
+
+    # device-binding contract (reference pins the device/env mapping,
+    # reference: tests/test_ddp_gpu.py:89-95): each process sees exactly
+    # its cpu_devices_per_process devices, the global mesh spans all
+    # processes' devices, and the rank mapping holds
+    assert len(jax.local_devices()) == 2
+    assert jax.device_count() == 2 * nprocs
+    assert jax.process_index() == process_id
+    assert {d.process_index for d in jax.devices()} == set(range(nprocs))
     from ray_lightning_accelerators_tpu import DataLoader, Trainer
     from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
     from tests.utils import BoringModel
