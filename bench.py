@@ -188,10 +188,13 @@ def bench_cifar() -> dict:
     from ray_lightning_accelerators_tpu.models.resnet import (
         CIFAR10DataModule, ResNet18)
 
+    import os
+
     n_devices = jax.device_count()
     batch = 256 * n_devices
     dm = CIFAR10DataModule(batch_size=batch, n_train=batch * 12,
-                           n_val=batch * 2)
+                           n_val=batch * 2,
+                           data_dir=os.environ.get("RLA_TPU_DATA_DIR"))
     dm.setup("fit")
 
     model = ResNet18({"lr": 0.05, "batch_size": batch})

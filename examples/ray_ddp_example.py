@@ -19,9 +19,14 @@ from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
 def train_mnist(config, num_epochs=10, num_workers=1, callbacks=None,
                 data_dir=None, smoke=False, agents=None):
     model = MNISTClassifier(config, data_dir)
+    # real MNIST IDX files under data_dir (or $RLA_TPU_DATA_DIR) are parsed
+    # directly; synthetic fallback otherwise (the reference downloads via
+    # torchvision, examples/ray_ddp_example.py:37-42 -- no egress here)
     dm = MNISTDataModule(batch_size=config["batch_size"],
                          n_train=2048 if smoke else 55000,
-                         n_val=512 if smoke else 5000)
+                         n_val=512 if smoke else 5000,
+                         data_dir=data_dir or os.environ.get(
+                             "RLA_TPU_DATA_DIR"))
     accelerator = RayTPUAccelerator(
         num_workers=num_workers,
         num_hosts=len(agents) if agents else 1, agents=agents)

@@ -7,11 +7,15 @@ LightningMNISTClassifier).  TPU-native notes: dense layers sized to MXU-
 friendly multiples by default, compute in the trainer's precision policy
 (bf16 on TPU), loss/accuracy computed in f32.
 
-Data: this environment has no dataset egress, so `MNISTDataModule` ships a
-deterministic synthetic MNIST (class-conditional digit-like patterns + noise)
-with the real tensor shapes [28*28] -- the training dynamics (imgs/sec) are
-identical to real MNIST at equal shapes, and accuracy gates remain
-meaningful because the task is learnable but not trivial.
+Data: `MNISTDataModule(data_dir=...)` parses REAL MNIST IDX files directly
+when present (data/vision.py -- no torchvision, no downloads; the
+reference's gate runs on real MNIST, reference:
+ray_lightning/tests/utils.py:137-152).  Without files it ships a
+deterministic synthetic MNIST (class-conditional digit-like patterns +
+noise) with the real tensor shapes [28*28] -- training dynamics (imgs/sec)
+are identical to real MNIST at equal shapes, and accuracy gates remain
+meaningful because the task is learnable but not trivial.  ``dm.source``
+reports which backing a run used.
 """
 
 from __future__ import annotations
@@ -106,17 +110,41 @@ def synthetic_mnist(n: int, seed: int = 0):
 
 
 class MNISTDataModule(DataModule):
+    """Real MNIST when IDX files exist under ``data_dir`` (parsed directly,
+    no torchvision -- data/vision.py; the reference gates on real MNIST,
+    reference: ray_lightning/tests/utils.py:137-152), synthetic otherwise.
+    ``source`` reports which one backed this run."""
+
     def __init__(self, batch_size: int = 128, n_train: int = 55000,
-                 n_val: int = 5000, seed: int = 0):
+                 n_val: int = 5000, seed: int = 0,
+                 data_dir: Optional[str] = None):
         self.batch_size = batch_size
         self.n_train, self.n_val, self.seed = n_train, n_val, seed
+        self.data_dir = data_dir
+        self.source = "synthetic"
         self._train = self._val = None
 
     def setup(self, stage: str) -> None:
-        if self._train is None:
-            x, y = synthetic_mnist(self.n_train + self.n_val, self.seed)
-            self._train = (x[:self.n_train], y[:self.n_train])
-            self._val = (x[self.n_train:], y[self.n_train:])
+        if self._train is not None:
+            return
+        if self.data_dir is not None:
+            from ..data import vision
+            real = vision.load_mnist(self.data_dir, "train")
+            if real is not None:
+                x, y = real
+                n_train = min(self.n_train, len(x) - 1)
+                self._train = (x[:n_train], y[:n_train])
+                # val = held-out tail of train, capped at n_val; test =
+                # the t10k split when present
+                self._val = (x[n_train:n_train + self.n_val],
+                             y[n_train:n_train + self.n_val])
+                self._test = vision.load_mnist(self.data_dir, "test")
+                self.source = "real"
+                return
+        x, y = synthetic_mnist(self.n_train + self.n_val, self.seed)
+        self._train = (x[:self.n_train], y[:self.n_train])
+        self._val = (x[self.n_train:], y[self.n_train:])
+        self._test = None
 
     def train_dataloader(self):
         return DataLoader(ArrayDataset(*self._train),
@@ -127,5 +155,7 @@ class MNISTDataModule(DataModule):
                           batch_size=self.batch_size)
 
     def test_dataloader(self):
-        return DataLoader(ArrayDataset(*self._val),
+        arrays = self._test if getattr(self, "_test", None) is not None \
+            else self._val
+        return DataLoader(ArrayDataset(*arrays),
                           batch_size=self.batch_size)

@@ -215,17 +215,42 @@ def synthetic_cifar10(n: int, seed: int = 0):
 
 
 class CIFAR10DataModule(DataModule):
+    """Real CIFAR-10 when the binary batches exist under ``data_dir``
+    (parsed directly, data/vision.py), synthetic otherwise; ``source``
+    reports which one backed this run."""
+
     def __init__(self, batch_size: int = 256, n_train: int = 50000,
-                 n_val: int = 10000, seed: int = 0):
+                 n_val: int = 10000, seed: int = 0,
+                 data_dir: Optional[str] = None):
         self.batch_size = batch_size
         self.n_train, self.n_val, self.seed = n_train, n_val, seed
+        self.data_dir = data_dir
+        self.source = "synthetic"
         self._train = self._val = None
 
     def setup(self, stage: str) -> None:
-        if self._train is None:
-            x, y = synthetic_cifar10(self.n_train + self.n_val, self.seed)
-            self._train = (x[:self.n_train], y[:self.n_train])
-            self._val = (x[self.n_train:], y[self.n_train:])
+        if self._train is not None:
+            return
+        if self.data_dir is not None:
+            from ..data import vision
+            real = vision.load_cifar10(self.data_dir, "train")
+            if real is not None:
+                x, y = real
+                test = vision.load_cifar10(self.data_dir, "test")
+                if test is not None:
+                    n_train = min(self.n_train, len(x))
+                    tx, ty = test
+                    self._val = (tx[:self.n_val], ty[:self.n_val])
+                else:  # no test batch: hold out a tail of train for val
+                    n_train = min(self.n_train, len(x) - 1)
+                    self._val = (x[n_train:n_train + self.n_val],
+                                 y[n_train:n_train + self.n_val])
+                self._train = (x[:n_train], y[:n_train])
+                self.source = "real"
+                return
+        x, y = synthetic_cifar10(self.n_train + self.n_val, self.seed)
+        self._train = (x[:self.n_train], y[:self.n_train])
+        self._val = (x[self.n_train:], y[self.n_train:])
 
     def train_dataloader(self):
         return DataLoader(ArrayDataset(*self._train),

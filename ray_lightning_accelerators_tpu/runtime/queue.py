@@ -82,6 +82,7 @@ class QueueServer:
                              daemon=True).start()
 
     def _reader(self, conn) -> None:
+        from .agent import send_msg
         while True:
             try:
                 item = self._recv_msg(conn)
@@ -91,6 +92,15 @@ class QueueServer:
                 except OSError:
                     pass
                 return
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == "__rla_ack__":
+                # flush barrier: everything this client sent earlier is
+                # already enqueued locally (same reader thread, in order)
+                try:
+                    send_msg(conn, item)
+                except OSError:
+                    pass
+                continue
             self._queue.put(item)
 
     def close(self) -> None:
@@ -119,6 +129,17 @@ class QueueClient:
         from .agent import send_msg
         with self._lock:
             send_msg(self._sock, item)
+
+    def flush(self) -> None:
+        """Block until everything put() so far is ENQUEUED on the driver.
+
+        Workers call this before returning their result: the result
+        travels a different channel (the worker pipe) and could otherwise
+        outrun the queue's reader thread, losing final reports."""
+        from .agent import recv_msg, send_msg
+        with self._lock:
+            send_msg(self._sock, ("__rla_ack__", 0))
+            recv_msg(self._sock)
 
     def empty(self) -> bool:
         return True

@@ -144,8 +144,16 @@ def report(**metrics) -> None:
     Callable from the driver thread (via trampoline thunks, the reference
     path) or directly from the trial thread (convenience the reference
     lacked -- its workers had no session and HAD to trampoline,
-    reference: tune.py:97-101).
+    reference: tune.py:97-101).  Inside a PROCESS trial there is no local
+    trial session; the call trampolines itself to the driver through the
+    runtime session's queue (exactly the reference's worker->trial-process
+    report flow, reference: tune.py:101 -> session.py:61-63).
     """
+    if _current_session() is None:
+        from ..runtime import session as rt_session
+        if rt_session.session_exists():
+            rt_session.put_queue(lambda: report(**metrics))
+            return
     get_trial_session().report(**metrics)
 
 
@@ -252,6 +260,113 @@ def _execute_trial(trainable, trial: Trial, scheduler, devices,
                     trial.last_result)
 
 
+def _process_trial_main(trainable, config, queue_address, trial_rank):
+    """Body of a PROCESS-isolated trial: runs inside a fresh worker
+    subprocess; report/checkpoint thunks reach the driver through the
+    network queue under this trial's rank."""
+    from ..runtime import session as session_lib
+    from ..runtime.queue import QueueClient
+
+    client = QueueClient(queue_address)
+    session_lib.init_session(trial_rank, client)
+    try:
+        return trainable(config)
+    finally:
+        # barrier: the trial's result races its last reports (different
+        # channels); flush guarantees the driver enqueued them first
+        client.flush()
+
+
+def _run_trials_in_processes(trainable, trials, scheduler,
+                             max_concurrent: int,
+                             raise_on_failed_trial: bool, verbose: int,
+                             trial_env: Optional[Dict[str, str]]):
+    """One fresh worker subprocess per trial (the reference's trial
+    isolation: Tune trials are separate processes,
+    examples/ray_ddp_example.py:101-113).  A trial that hard-crashes
+    (os._exit, fatal XLA error) is recorded as ERROR; the experiment
+    continues.  Thunks carry the trial's rank, and the drain binds that
+    trial's session before executing, so concurrent trials can't
+    cross-report."""
+    import time as time_mod
+
+    from ..runtime.actors import Worker
+    from ..runtime.queue import QueueServer, TrampolineQueue
+
+    q = TrampolineQueue()
+    server = QueueServer(q)
+    sessions = {i: _TrialSession(t, scheduler) for i, t in enumerate(trials)}
+
+    def drain() -> None:
+        while True:
+            item = q.get_nowait()
+            if item is None:
+                return
+            rank, thunk = item
+            _bind_trial_session(sessions.get(rank))
+            try:
+                thunk()
+            except Exception as e:
+                # a failing thunk (checkpoint write, scheduler decision)
+                # must not abort the whole experiment when failures are
+                # tolerated; record it on the owning trial
+                if rank in sessions:
+                    sessions[rank].trial.error = e
+                log.warning("trial thunk failed (trial %s): %s",
+                            sessions[rank].trial.trial_id
+                            if rank in sessions else rank, e)
+                if raise_on_failed_trial:
+                    failures.append(e)
+            finally:
+                _bind_trial_session(None)
+
+    pending: Dict[int, tuple] = {}  # idx -> (worker, future)
+    queue_idx = list(range(len(trials)))
+    failures: List[BaseException] = []
+    try:
+        while queue_idx or pending:
+            while queue_idx and len(pending) < max_concurrent:
+                i = queue_idx.pop(0)
+                trials[i].status = "RUNNING"
+                w = Worker(i, dict(trial_env or {}))
+                fut = w.execute(_process_trial_main, trainable,
+                                trials[i].config, server.address, i)
+                pending[i] = (w, fut)
+            drain()
+            for i, (w, fut) in list(pending.items()):
+                if not fut.done():
+                    continue
+                drain()  # results enqueued before completion land first
+                trial = trials[i]
+                err = fut.exception()
+                if err is not None:
+                    trial.status = "ERROR"
+                    trial.error = err
+                    log.warning("trial %s failed: %s", trial.trial_id, err)
+                    if raise_on_failed_trial:
+                        failures.append(err)
+                else:
+                    trial.status = ("STOPPED" if trial.should_stop
+                                    else "TERMINATED")
+                    if verbose:
+                        log.warning("trial %s finished: %s", trial.trial_id,
+                                    trial.last_result)
+                w.kill()
+                del pending[i]
+                if failures:
+                    queue_idx.clear()
+            if failures:
+                break
+            time_mod.sleep(0.01)
+        drain()
+    finally:
+        for w, _f in pending.values():
+            w.kill()
+        server.close()
+    if failures:
+        raise failures[0]
+
+
 def run(trainable: Callable[[Dict[str, Any]], Any],
         config: Optional[Dict[str, Any]] = None,
         num_samples: int = 1,
@@ -267,15 +382,27 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         search_alg=None,
         max_concurrent_trials: int = 1,
         devices_per_trial: Optional[int] = None,
+        trial_executor: str = "thread",
+        trial_env: Optional[Dict[str, str]] = None,
         **_compat_kwargs) -> ExperimentAnalysis:
     """Run `trainable(config)` for every sampled/grid config.
 
-    `resources_per_trial` is accepted for signature parity (the reference's
-    extra_cpu bookkeeping, examples/ray_ddp_example.py:107-112) -- placement
-    is meaningful only under the multi-host actor runtime.  `scheduler` is a
-    tune.schedulers.TrialScheduler (e.g. ASHAScheduler) consulted on every
-    reported result; its STOP decisions end trials early and mark them
-    STOPPED.
+    ``trial_executor``: "thread" (default -- trials share this process and
+    its devices; on TPU one process owns the chips) or "process" -- each
+    trial runs in a FRESH subprocess (the reference's isolation: Tune
+    trials are separate processes, examples/ray_ddp_example.py:101-113), so
+    a hard crash (OOM, fatal XLA error, os._exit) marks that trial ERROR
+    while the experiment completes.  ``trial_env`` sets env vars in trial
+    subprocesses pre-fork (e.g. JAX_PLATFORMS / XLA device counts).
+
+    `resources_per_trial` (the reference's cpu/extra_cpu bookkeeping,
+    examples/ray_ddp_example.py:107-112) caps process-executor concurrency
+    so trials never oversubscribe the host: at most
+    ``os.cpu_count() // (cpu + extra_cpu)`` trials run at once.
+    `scheduler` is a tune.schedulers.TrialScheduler (e.g. ASHAScheduler)
+    consulted on every reported result; its STOP decisions end trials
+    early and mark them STOPPED (thread executor; process trials record
+    the decision but run to completion).
 
     ``max_concurrent_trials > 1`` runs trials in parallel over disjoint
     device partitions — the trials x workers-per-trial parallelism the
@@ -290,20 +417,40 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
     exp_dir = os.path.join(local_dir, name)
     os.makedirs(exp_dir, exist_ok=True)
 
+    if trial_executor not in ("thread", "process"):
+        raise ValueError(f"trial_executor must be 'thread' or 'process', "
+                         f"got {trial_executor!r}")
     if scheduler is not None:
         scheduler.set_search_properties(metric, mode)
     if search_alg is not None:
-        if max_concurrent_trials > 1:
+        if max_concurrent_trials > 1 or trial_executor == "process":
             raise ValueError(
                 "search_alg suggests each trial from completed-trial "
-                "history and requires sequential trials "
-                "(max_concurrent_trials=1)")
+                "history and requires sequential in-process trials "
+                "(max_concurrent_trials=1, trial_executor='thread')")
         # model-based sequential search: each config is suggested from the
         # history of completed trials instead of sampled up front
         search_alg.set_search_properties(metric, mode)
         configs = [None] * num_samples
     else:
         configs = generate_trial_configs(config, num_samples, seed)
+
+    if trial_executor == "process":
+        trials = [Trial(f"trial_{i:05d}", cfg, exp_dir)
+                  for i, cfg in enumerate(configs)]
+        concurrent = max(1, max_concurrent_trials)
+        if resources_per_trial:
+            per = (int(resources_per_trial.get("cpu", 1))
+                   + int(resources_per_trial.get("extra_cpu", 0)))
+            cap = max(1, (os.cpu_count() or 1) // max(1, per))
+            if cap < concurrent:
+                log.warning("resources_per_trial caps concurrency at %d "
+                            "(%d host cpus / %d per trial)", cap,
+                            os.cpu_count() or 1, per)
+            concurrent = min(concurrent, cap)
+        _run_trials_in_processes(trainable, trials, scheduler, concurrent,
+                                 raise_on_failed_trial, verbose, trial_env)
+        return ExperimentAnalysis(trials, metric, mode)
 
     if max_concurrent_trials > 1:
         import queue as queue_mod
