@@ -197,7 +197,11 @@ def bench_cifar() -> dict:
                            data_dir=os.environ.get("RLA_TPU_DATA_DIR"))
     dm.setup("fit")
 
-    model = ResNet18({"lr": 0.05, "batch_size": batch})
+    # lr 0.01: stable convergence on this short synthetic run -- higher
+    # rates sit in a chaotic regime where val_acc depends on rounding
+    # noise (verified: at 0.02-0.05 both executor paths land anywhere in
+    # [0.09, 0.93] run to run)
+    model = ResNet18({"lr": 0.01, "batch_size": batch})
     clock = _EpochClock(Callback)
     epochs = 4
     trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),

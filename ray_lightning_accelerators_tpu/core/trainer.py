@@ -158,6 +158,7 @@ class Trainer:
         self._val_loader = None
         self._device_cache = None
         self._train_step_cached_fn = None
+        self._epoch_scan_fn = None
 
     # ------------------------------------------------------------------ #
     # Checkpoint plumbing                                                #
@@ -361,23 +362,129 @@ class Trainer:
             out_shardings=(state_sh, repl),
             donate_argnums=0)
 
+        # whole-epoch fusion: ONE dispatch runs every step of an epoch as
+        # a lax.scan over the index matrix.  Per-step dispatch overhead
+        # (severe over a tunneled/remote PjRt link) leaves the hot loop
+        # entirely; metrics come back stacked [n_steps, ...] for
+        # after-the-fact logging
+        def scanned_epoch(st, cache, idx_mat):
+            def body(carry, idx):
+                return cached_step(carry, cache, idx)
+            return jax.lax.scan(body, st, idx_mat)
+
+        self._epoch_scan_fn = jax.jit(
+            scanned_epoch,
+            in_shardings=(state_sh, repl, repl),
+            out_shardings=(state_sh, repl),
+            donate_argnums=0)
+
+    def _can_scan_epoch(self) -> bool:
+        """Whole-epoch fusion is eligible when nothing needs the host
+        between steps: device cache active, no mid-epoch validation, no
+        wall-clock budget (max_time resolves per step in loop mode), no
+        per-step profiler spans, and no callback overriding
+        on_train_batch_end (the scan cannot call back per step)."""
+        if self._epoch_scan_fn is None or self._device_cache is None:
+            return False
+        if self.val_check_interval or self.max_time is not None:
+            return False
+        if self.profiler is not None:
+            return False
+
+        def overrides_batch_end(c) -> bool:
+            fn = getattr(c, "on_train_batch_end", None)
+            # __func__ comparison also catches instance-attribute hooks
+            # (c.on_train_batch_end = my_fn), which plain functions lack
+            return getattr(fn, "__func__", None) \
+                is not Callback.on_train_batch_end
+
+        return not any(overrides_batch_end(c) for c in self.callbacks)
+
+    # -- shared epoch materialization (single source of truth for the    #
+    #    step loop and the scanned path)                                 #
+    @staticmethod
+    def _epoch_index_plan(loader):
+        """(sampler permutation, batch_size, number of FULL batches)."""
+        perm = np.fromiter(loader.sampler, np.int64)
+        bs = loader.batch_size
+        return perm, bs, len(perm) // bs
+
+    @staticmethod
+    def _tail_host_batch(loader, perm, full_nb):
+        """The trailing partial batch (drop_last=False), or None."""
+        tail = perm[full_nb * loader.batch_size:]
+        if not len(tail) or loader.drop_last:
+            return None
+        arrays = loader.dataset._native_arrays()
+        batch = tuple(a[tail] for a in arrays)
+        return batch[0] if len(batch) == 1 else batch
+
+    def _run_scanned_epoch(self, state, loader):
+        """One dispatch for the epoch's whole-batch steps; returns
+        (state, last-step metrics dict, epoch_complete).  The trailing
+        partial batch (drop_last=False) still runs through the host path.
+        Guard conditions mirror the step loop exactly: a max_steps budget
+        hit anywhere in the epoch marks it incomplete and stops."""
+        perm, bs, full_nb = self._epoch_index_plan(loader)
+        nb_epoch = full_nb
+        if self.limit_train_batches is not None:
+            nb_epoch = min(nb_epoch, self.limit_train_batches)
+        nb = nb_epoch
+        if self.max_steps:
+            nb = min(nb, max(0, self.max_steps - self.global_step))
+        budget_cut = nb < nb_epoch  # max_steps ends the epoch early
+        train_metrics: Dict[str, Any] = {}
+        if nb:
+            idx_mat = jax.device_put(
+                perm[:nb * bs].astype(np.int32).reshape(nb, bs))
+            state, stacked = self._epoch_scan_fn(state, self._device_cache,
+                                                 idx_mat)
+            first_step = self.global_step
+            self.global_step += nb
+            self._state = state
+            train_metrics = {k: v[-1] for k, v in stacked.items()}
+            # replay periodic logging from the stacked metrics
+            cadence = self.log_every_n_steps
+            hits = [i for i in range(nb)
+                    if (first_step + i + 1) % cadence == 0]
+            if hits:
+                host = jax.device_get(stacked)
+                for i in hits:
+                    self._log_now({k: float(v[i])
+                                   for k, v in host.items()},
+                                  step=first_step + i + 1)
+
+        def budget_hit() -> bool:
+            return bool(self.max_steps
+                        and self.global_step >= self.max_steps)
+
+        tail = self._tail_host_batch(loader, perm, full_nb)
+        if (tail is not None and not budget_hit() and nb == full_nb
+                and (self.limit_train_batches is None
+                     or full_nb < self.limit_train_batches)):
+            batch = self._put_batch(tail)
+            state, train_metrics = self._train_step_fn(state, batch)
+            self.global_step += 1
+            self._state = state
+        if budget_hit():
+            # loop parity: the step loop breaks on the budget check after
+            # the batch, leaving the epoch incomplete
+            self.should_stop = True
+        return state, train_metrics, not (budget_cut or budget_hit())
+
     def _cached_epoch_source(self, loader):
         """Yield per-step device index rows (plus a host-path trailing
         partial batch when drop_last=False), honoring the loader's sampler
         order exactly."""
-        perm = np.fromiter(loader.sampler, np.int64)
-        bs = loader.batch_size
-        nb = len(perm) // bs
+        perm, bs, nb = self._epoch_index_plan(loader)
         if nb:
             idx_mat = jax.device_put(
                 perm[:nb * bs].astype(np.int32).reshape(nb, bs))
             for i in range(nb):
                 yield ("cached", idx_mat[i])
-        tail = perm[nb * bs:]
-        if len(tail) and not loader.drop_last:
-            arrays = loader.dataset._native_arrays()
-            batch = tuple(a[tail] for a in arrays)
-            yield ("host", batch[0] if len(batch) == 1 else batch)
+        tail = self._tail_host_batch(loader, perm, nb)
+        if tail is not None:
+            yield ("host", tail)
 
     def _put_batch(self, batch):
         """Ship one host batch to the mesh with the batch sharding.
@@ -569,11 +676,20 @@ class Trainer:
             self.sanity_checking = False
 
         train_metrics: Dict[str, Any] = {}
+        use_scan = self._can_scan_epoch()
         while not self._done():
             for c in self.callbacks:
                 c.on_train_epoch_start(self, module)
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(self.current_epoch)
+
+            if use_scan:
+                state, train_metrics, complete = self._run_scanned_epoch(
+                    state, train_loader)
+                if complete:
+                    self.epochs_completed = self.current_epoch + 1
+                self._after_train_epoch(module, train_metrics)
+                continue
 
             if self._device_cache is not None:
                 source = self._cached_epoch_source(train_loader)
@@ -626,46 +742,7 @@ class Trainer:
             if (self.limit_train_batches is not None
                     and not self.should_stop):
                 self.epochs_completed = self.current_epoch + 1
-
-            # harvest train metrics for callback_metrics at epoch boundary
-            if train_metrics:
-                self.callback_metrics.update(
-                    {k: float(v) for k, v in
-                     jax.device_get(train_metrics).items()})
-
-            run_val = (self._val_loader is not None and
-                       (self.current_epoch + 1) % self.check_val_every_n_epoch == 0)
-            if run_val and getattr(self, "_last_val_step", -1) == self.global_step:
-                # a val_check_interval pass just ran at this exact step;
-                # don't validate the same params twice (double-counts
-                # EarlyStopping patience and ModelCheckpoint saves)
-                run_val = False
-            if run_val:
-                for c in self.callbacks:
-                    c.on_validation_start(self, module)
-                with self._span("validation"):
-                    val_metrics = self._run_eval(self._val_loader,
-                                                 self._eval_step_fn,
-                                                 limit=self.limit_val_batches,
-                                                 prefix=None)
-                self.callback_metrics.update(val_metrics)
-                self._log_now(val_metrics)
-                module.on_validation_epoch_end()
-                for c in self.callbacks:
-                    c.on_validation_end(self, module)
-            for c in self.callbacks:
-                c.on_train_epoch_end(self, module)
-            if not run_val and self._val_loader is None:
-                # checkpoint/early-stop callbacks keyed on validation_end
-                # still fire once per epoch on train metrics
-                for c in self.callbacks:
-                    c.on_validation_end(self, module)
-            self.current_epoch += 1
-            if self.enable_progress_bar:
-                log.warning("epoch %d done (step %d) metrics=%s",
-                            self.current_epoch, self.global_step,
-                            {k: round(v, 5) for k, v in
-                             self.callback_metrics.items()})
+            self._after_train_epoch(module, train_metrics)
 
         # re-hydrate weights into the user's module on the driver
         # (reference: ray_ddp.py:185-189)
@@ -680,6 +757,50 @@ class Trainer:
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
         self.fit_duration_s = time.perf_counter() - t0
+
+    def _after_train_epoch(self, module, train_metrics) -> None:
+        """Epoch epilogue shared by the step loop and the scanned path:
+        harvest metrics, run epoch-boundary validation, fire callbacks,
+        advance the epoch counter."""
+        if train_metrics:
+            self.callback_metrics.update(
+                {k: float(v) for k, v in
+                 jax.device_get(train_metrics).items()})
+
+        run_val = (self._val_loader is not None and
+                   (self.current_epoch + 1) % self.check_val_every_n_epoch
+                   == 0)
+        if run_val and getattr(self, "_last_val_step", -1) == self.global_step:
+            # a val_check_interval pass just ran at this exact step;
+            # don't validate the same params twice (double-counts
+            # EarlyStopping patience and ModelCheckpoint saves)
+            run_val = False
+        if run_val:
+            for c in self.callbacks:
+                c.on_validation_start(self, module)
+            with self._span("validation"):
+                val_metrics = self._run_eval(self._val_loader,
+                                             self._eval_step_fn,
+                                             limit=self.limit_val_batches,
+                                             prefix=None)
+            self.callback_metrics.update(val_metrics)
+            self._log_now(val_metrics)
+            module.on_validation_epoch_end()
+            for c in self.callbacks:
+                c.on_validation_end(self, module)
+        for c in self.callbacks:
+            c.on_train_epoch_end(self, module)
+        if not run_val and self._val_loader is None:
+            # checkpoint/early-stop callbacks keyed on validation_end
+            # still fire once per epoch on train metrics
+            for c in self.callbacks:
+                c.on_validation_end(self, module)
+        self.current_epoch += 1
+        if self.enable_progress_bar:
+            log.warning("epoch %d done (step %d) metrics=%s",
+                        self.current_epoch, self.global_step,
+                        {k: round(v, 5) for k, v in
+                         self.callback_metrics.items()})
 
     def _mid_epoch_validation(self, module) -> None:
         """Validation pass at a step boundary (val_check_interval); fires
@@ -742,9 +863,11 @@ class Trainer:
                     f"global batch dim {n} not divisible by data-parallel "
                     f"size {dp_local}; adjust batch_size or drop_last")
 
-    def _log_now(self, metrics: Dict[str, float]) -> None:
+    def _log_now(self, metrics: Dict[str, float],
+                 step: Optional[int] = None) -> None:
         if self.logger is not None and metrics and jax.process_index() == 0:
-            self.logger.log_metrics(metrics, self.global_step)
+            self.logger.log_metrics(
+                metrics, self.global_step if step is None else step)
 
     # ------------------------------------------------------------------ #
     # eval loops                                                         #
@@ -863,6 +986,7 @@ class Trainer:
         self._state = None
         self._device_cache = None
         self._train_step_cached_fn = None
+        self._epoch_scan_fn = None
         self.accelerator.teardown()
 
 

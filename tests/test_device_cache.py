@@ -119,3 +119,116 @@ def test_epoch_reshuffle_respected_when_cached():
     train.set_epoch(1)
     second = np.fromiter(train.sampler, np.int64)
     assert not np.array_equal(first, second)
+
+
+def test_scanned_epoch_engages_and_skips_per_step_dispatch():
+    """With the cache active and no per-step host needs, the whole epoch
+    runs as ONE lax.scan dispatch: the per-step cached fn is never
+    called."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=True)
+    trainer = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True,
+                      log_every_n_steps=10 ** 9)
+    calls = []
+    orig_compile = trainer._compile
+
+    def probe_compile(*a, **kw):
+        orig_compile(*a, **kw)
+        fn = trainer._train_step_cached_fn
+        trainer._train_step_cached_fn = \
+            lambda *args: calls.append(1) or fn(*args)
+
+    trainer._compile = probe_compile
+    trainer.fit(BoringModel(), train)
+    assert trainer.global_step == 16
+    assert calls == []  # scan path: zero per-step dispatches
+
+
+def test_scanned_epoch_respects_max_steps():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+    trainer = Trainer(max_steps=11, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True)
+    trainer.fit(BoringModel(), train)
+    assert trainer.global_step == 11   # 8 (epoch 1) + 3 (truncated epoch 2)
+    assert trainer.epochs_completed == 1
+
+
+def test_batch_end_callback_falls_back_to_step_loop():
+    from ray_lightning_accelerators_tpu import Callback
+
+    class PerStep(Callback):
+        def __init__(self):
+            self.n = 0
+
+        def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+            self.n += 1
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+    cb = PerStep()
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True, callbacks=[cb])
+    trainer.fit(BoringModel(), train)
+    assert not trainer._can_scan_epoch()
+    assert cb.n == 4  # per-step callback still fires, on the loop path
+
+
+def test_scanned_epoch_logs_on_cadence():
+    from ray_lightning_accelerators_tpu.utils.logging import InMemoryLogger
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+    logger = InMemoryLogger()
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True, logger=logger,
+                      log_every_n_steps=3)
+    trainer.fit(BoringModel(), train)
+    steps = [row["step"] for row in logger.history if "train_loss" in row]
+    assert steps == [3, 6]  # 8 steps, cadence 3
+
+
+def test_scanned_epoch_max_steps_at_last_batch_parity():
+    """max_steps landing exactly on the last full batch must not run the
+    trailing partial batch nor mark the epoch complete (step-loop
+    parity)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((68, 32)).astype(np.float32)
+
+    def run(cache):
+        train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False,
+                           drop_last=False)
+        trainer = Trainer(max_steps=8, accelerator=RayTPUAccelerator(),
+                          precision="f32", enable_checkpointing=False,
+                          seed=0, cache_dataset_on_device=cache)
+        trainer.fit(BoringModel(), train)
+        return (trainer.global_step, trainer.epochs_completed,
+                trainer.should_stop)
+
+    assert run(True) == run(False) == (8, 0, True)
+
+
+def test_instance_attribute_batch_end_hook_disables_scan():
+    from ray_lightning_accelerators_tpu import Callback
+
+    hits = []
+    cb = Callback()
+    cb.on_train_batch_end = \
+        lambda trainer, module, metrics, batch_idx: hits.append(batch_idx)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 32)).astype(np.float32)
+    train = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False, seed=0,
+                      cache_dataset_on_device=True, callbacks=[cb])
+    trainer.fit(BoringModel(), train)
+    assert hits == [0, 1, 2, 3]  # hook fired; scan path stood down
