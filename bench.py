@@ -16,7 +16,8 @@
   + val_acc.
 - ``decode`` (inference): GPT-2-small greedy KV-cache decode tokens/sec
   (bf16 headline, int8 weight-only ratio), with vs_baseline measured
-  against this chip's own weight-streaming roofline.
+  against this chip's own weight-streaming roofline probed with a
+  matmul-shaped read (the access pattern decode actually has).
 
 Each timed region is the steady state of a single public-API ``fit`` --
 epoch 1 absorbs compile + the one-time device-cache shipment, later epochs
@@ -305,34 +306,49 @@ def bench_decode() -> dict:
     tps_bf16 = prompt.shape[0] * new_tokens / dt_bf16
     tps_q8 = prompt.shape[0] * new_tokens / dt_q8
 
-    # this chip's own weight-streaming roofline.  Chain several reads and
-    # sync ONCE at the end -- a per-call sync would bill the tunnel's
-    # round-trip latency to the bandwidth number
-    probe = jnp.ones((128, 1024, 1024), jnp.bfloat16)  # 256 MB
-    reader = jax.jit(lambda x, s: x.sum() + s)
-    float(reader(probe, jnp.float32(0)))  # warmup/compile
-    # best of 3 rounds x 12 chained reads (3 GB each): the tunnel adds
-    # multi-hundred-ms jitter that a short probe bills to bandwidth
+    # this chip's own weight-streaming roofline, measured with a
+    # MATMUL-shaped probe -- decode's actual access pattern is a small
+    # activation block multiplying a stream of weight matrices into the
+    # MXU, which this chip moves faster than a reduce-style read (round
+    # 2's reduce probe under-read at 27 GB/s and made decode "beat" its
+    # own roofline by 52%; a ratio > 1 against a physical ceiling is a
+    # probe bug, not a win).  Chain several passes and sync ONCE at the
+    # end -- a per-call sync would bill tunnel round-trips to bandwidth.
+    L, d = 48, 2048
+    w_stack = jnp.ones((L, d, d), jnp.bfloat16) / d  # 384 MB
+    xact = jnp.ones((prompt.shape[0], d), jnp.bfloat16)
+
+    def stream(x, s):
+        def body(carry, w):
+            return (carry @ w).astype(jnp.bfloat16), ()
+        out, _ = jax.lax.scan(body, x, w_stack)
+        return out.astype(jnp.float32).sum() + s
+
+    reader = jax.jit(stream)
+    float(reader(xact, jnp.float32(0)))  # warmup/compile
     reps = 12
     best = float("inf")
     for _ in range(3):
         t0 = time_mod.perf_counter()
         acc = jnp.float32(0)
         for _ in range(reps):
-            acc = reader(probe, acc)
+            acc = reader(xact, acc)
         float(acc)
         best = min(best, time_mod.perf_counter() - t0)
-    hbm_bps = reps * probe.nbytes / best
+    stream_bps = reps * w_stack.nbytes / best
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
-    roofline_tps = prompt.shape[0] * hbm_bps / (2 * n_params)
+    # ideal decode: every token re-reads every bf16 weight byte at the
+    # measured matmul-stream rate (KV-cache traffic ignored -- it only
+    # LOWERS attainable tokens/sec, keeping this a true ceiling)
+    roofline_tps = prompt.shape[0] * stream_bps / (2 * n_params)
     return {
         "metric": "gpt2s_124m_decode_tokens_per_sec_per_chip",
         "value": round(tps_bf16, 1),
         "unit": "tokens/sec/chip",
         "int8_ratio": round(tps_q8 / tps_bf16, 3),
         "batch": prompt.shape[0],
-        "hbm_gbps_measured": round(hbm_bps / 1e9, 1),
+        "weight_stream_gbps_measured": round(stream_bps / 1e9, 1),
         "vs_baseline": round(tps_bf16 / roofline_tps, 3),
     }
 

@@ -29,9 +29,10 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     ag = sub.add_parser("agent", help="run a per-host worker agent")
     ag.add_argument("--port", type=int, default=7777)
-    ag.add_argument("--bind", default="0.0.0.0",
+    ag.add_argument("--bind", default="127.0.0.1",
                     help="interface to listen on (agents execute arbitrary "
-                         "pickled code -- bind to trusted networks only)")
+                         "pickled code; non-loopback binds should set "
+                         "RLA_TPU_AGENT_TOKEN on agent and driver)")
 
     la = sub.add_parser(
         "launch", help="run a driver script against host agents")
@@ -42,7 +43,17 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     args = parser.parse_args(argv)
     if args.cmd == "agent":
-        from .runtime.agent import HostAgent
+        import os
+
+        from .runtime.agent import TOKEN_ENV, HostAgent
+        if args.bind not in ("127.0.0.1", "localhost") \
+                and not os.environ.get(TOKEN_ENV):
+            import warnings
+            warnings.warn(
+                f"agent binding {args.bind} without {TOKEN_ENV}: any host "
+                f"that can reach this port can execute code as this user; "
+                f"set {TOKEN_ENV} on agent and driver",
+                stacklevel=1)
         HostAgent(args.port, args.bind).serve_forever()
     elif args.cmd == "launch":
         import os

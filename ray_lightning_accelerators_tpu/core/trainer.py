@@ -330,8 +330,6 @@ class Trainer:
         from ..data.loader import default_collate
         if loader.collate_fn is not default_collate:
             return False
-        if jax.process_count() > 1:
-            return False  # multi-host feeds per-process shards
         total = sum(a.nbytes for a in arrays)
         if mode == "auto":
             if total > self._CACHE_MAX_BYTES:
@@ -341,12 +339,34 @@ class Trainer:
                 return False
         repl = jax.sharding.NamedSharding(self._mesh,
                                           jax.sharding.PartitionSpec())
-        self._device_cache = tuple(
-            jax.device_put(np.ascontiguousarray(a), repl) for a in arrays)
+        if jax.process_count() > 1:
+            # every process holds the full host dataset (the sampler, not
+            # the dataset, is what's sharded), so each can populate its
+            # addressable shards of a globally-replicated cache -- the
+            # per-process analog of the single-host device_put below
+            self._device_cache = tuple(
+                jax.make_array_from_callback(
+                    a.shape, repl, lambda i, a=a: a[i])
+                for a in (np.ascontiguousarray(x) for x in arrays))
+        else:
+            self._device_cache = tuple(
+                jax.device_put(np.ascontiguousarray(a), repl)
+                for a in arrays)
         self._cache_single = len(arrays) == 1
         return True
 
     def _compile_cached_step(self, train_step, state_sh, batch_sh, repl):
+        # index rows ride the batch sharding: each process contributes ITS
+        # sampler's (global dataset) indices to its own shard positions --
+        # the same contract _put_batch uses for host-fed data, so the
+        # gathered batch lands exactly where the host-fed batch would
+        from ..parallel.mesh import BATCH_AXES
+        idx_row_sh = batch_sh
+        idx_mat_sh = jax.sharding.NamedSharding(
+            self._mesh, jax.sharding.PartitionSpec(None, BATCH_AXES))
+        self._idx_row_sharding = idx_row_sh
+        self._idx_mat_sharding = idx_mat_sh
+
         def gather(cache, idx):
             batch = tuple(jnp.take(a, idx, axis=0) for a in cache)
             batch = batch[0] if self._cache_single else batch
@@ -358,7 +378,7 @@ class Trainer:
 
         self._train_step_cached_fn = jax.jit(
             cached_step,
-            in_shardings=(state_sh, repl, repl),
+            in_shardings=(state_sh, repl, idx_row_sh),
             out_shardings=(state_sh, repl),
             donate_argnums=0)
 
@@ -374,7 +394,7 @@ class Trainer:
 
         self._epoch_scan_fn = jax.jit(
             scanned_epoch,
-            in_shardings=(state_sh, repl, repl),
+            in_shardings=(state_sh, repl, idx_mat_sh),
             out_shardings=(state_sh, repl),
             donate_argnums=0)
 
@@ -435,7 +455,7 @@ class Trainer:
         budget_cut = nb < nb_epoch  # max_steps ends the epoch early
         train_metrics: Dict[str, Any] = {}
         if nb:
-            idx_mat = jax.device_put(
+            idx_mat = self._put_index_matrix(
                 perm[:nb * bs].astype(np.int32).reshape(nb, bs))
             state, stacked = self._epoch_scan_fn(state, self._device_cache,
                                                  idx_mat)
@@ -472,16 +492,33 @@ class Trainer:
             self.should_stop = True
         return state, train_metrics, not (budget_cut or budget_hit())
 
+    def _put_index_matrix(self, idx_mat: np.ndarray):
+        """Device-place a per-process (nb, local_bs) index matrix with the
+        batch-dim sharding (multi-process: assembled into the global
+        (nb, global_bs) matrix, the index analog of ``_put_batch``)."""
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                self._idx_mat_sharding, idx_mat)
+        return jax.device_put(idx_mat, self._idx_mat_sharding)
+
     def _cached_epoch_source(self, loader):
         """Yield per-step device index rows (plus a host-path trailing
         partial batch when drop_last=False), honoring the loader's sampler
         order exactly."""
         perm, bs, nb = self._epoch_index_plan(loader)
         if nb:
-            idx_mat = jax.device_put(
-                perm[:nb * bs].astype(np.int32).reshape(nb, bs))
-            for i in range(nb):
-                yield ("cached", idx_mat[i])
+            rows = perm[:nb * bs].astype(np.int32).reshape(nb, bs)
+            if jax.process_count() > 1:
+                # a global (nb, bs) matrix is not eagerly row-indexable
+                # across processes; assemble each global row directly
+                for i in range(nb):
+                    yield ("cached",
+                           jax.make_array_from_process_local_data(
+                               self._idx_row_sharding, rows[i]))
+            else:
+                idx_mat = jax.device_put(rows)
+                for i in range(nb):
+                    yield ("cached", idx_mat[i])
         tail = self._tail_host_batch(loader, perm, nb)
         if tail is not None:
             yield ("host", tail)
@@ -522,33 +559,27 @@ class Trainer:
             return None  # already inside a formed distributed world
         return self.accelerator.launch_spec()
 
-    def _fit_via_launcher(self, spec, module, train_dataloaders,
-                          val_dataloaders, datamodule, ckpt_path) -> None:
-        import functools
-
-        from ..runtime.bootstrap import launch_distributed
-        from ..runtime.queue import TrampolineQueue
-
-        n = spec["num_processes"]
+    def _spawn_platform(self, spec):
+        """(env, platform, cpu_devices_per_process) for the fan-out
+        workers.  CPU fan-out (tests / CI): each worker gets its share of
+        virtual devices and gloo collectives.  The env var is honored even
+        when a device plugin overrode the driver's own backend through
+        jax.config."""
         env = {"RLA_TPU_INSIDE_WORKER": "1"}
         platform = cpu_per = None
         env_platform = os.environ.get("JAX_PLATFORMS",
                                       "").split(",")[0].lower()
         if env_platform == "cpu" or jax.default_backend() == "cpu":
-            # CPU fan-out (tests / CI): each worker gets its share of
-            # virtual devices and gloo collectives.  The env var is
-            # honored even when a device plugin overrode the driver's own
-            # backend through jax.config.
             platform = "cpu"
             cpu_per = spec.get("devices_per_host") or 1
             env.update({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""})
-        log.warning("fanning fit out to %d processes via agents %s",
-                    n, spec.get("agents"))
+        return env, platform, cpu_per
 
-        # the payload must be free of live device/compiled objects: ship
-        # existing params as numpy (refit continuation works through the
-        # fan-out), and clear meshes / jitted fns / device caches a prior
-        # in-process fit left on the trainer and module
+    def _strip_for_shipment(self, module) -> None:
+        """The fan-out payload must be free of live device/compiled
+        objects: ship existing params as numpy (refit continuation works
+        through the fan-out), and clear meshes / jitted fns / device
+        caches a prior in-process fit left on the trainer and module."""
         if module.params is not None:
             module.params = jax.tree.map(
                 lambda x: np.asarray(jax.device_get(x)), module.params)
@@ -560,6 +591,19 @@ class Trainer:
             module.mesh = None
         if hasattr(module, "_jit_predict"):
             del module._jit_predict
+
+    def _fit_via_launcher(self, spec, module, train_dataloaders,
+                          val_dataloaders, datamodule, ckpt_path) -> None:
+        import functools
+
+        from ..runtime.bootstrap import launch_distributed
+        from ..runtime.queue import TrampolineQueue
+
+        n = spec["num_processes"]
+        env, platform, cpu_per = self._spawn_platform(spec)
+        log.warning("fanning fit out to %d processes via agents %s",
+                    n, spec.get("agents"))
+        self._strip_for_shipment(module)
 
         queue = TrampolineQueue()
         body = functools.partial(_remote_fit_worker, self, module,
@@ -589,6 +633,41 @@ class Trainer:
             # reference also makes (SURVEY.md §5.4)
             cb.best_model_path = r0["best_model_path"]
         self.fitting = False
+
+    def _eval_via_launcher(self, spec, module, dataloaders, datamodule,
+                           stage: str):
+        """validate/test/predict fanned out over host agents, exactly like
+        fit (the reference routes test through the same accelerator
+        machinery -- fit/test multi-call, reference: README.md:34-36,
+        ray_lightning/ray_ddp.py:99-195).  Rank-0 metrics re-hydrate into
+        the driver's trainer; predict outputs from every rank's sampler
+        shard re-interleave into global dataset order."""
+        import functools
+
+        from ..runtime.bootstrap import launch_distributed
+        from ..runtime.queue import TrampolineQueue
+
+        n = spec["num_processes"]
+        env, platform, cpu_per = self._spawn_platform(spec)
+        log.warning("fanning %s out to %d processes via agents %s",
+                    stage, n, spec.get("agents"))
+        self._strip_for_shipment(module)
+
+        queue = TrampolineQueue()
+        body = functools.partial(_remote_eval_worker, self, module,
+                                 dataloaders, datamodule, stage)
+        results = launch_distributed(
+            body, n, platform=platform, cpu_devices_per_process=cpu_per,
+            env=env, agents=spec.get("agents"), queue=queue)
+
+        module.trainer = self
+        self.module = module
+        if stage == "predict":
+            return _interleave_predictions(
+                [r["outputs"] for r in results])
+        r0 = results[0]
+        self.callback_metrics.update(r0["metrics"])
+        return r0["results"]
 
     def fit(self, module: TpuModule,
             train_dataloaders=None, val_dataloaders=None,
@@ -945,6 +1024,10 @@ class Trainer:
 
     def validate(self, module: TpuModule, dataloaders=None,
                  datamodule=None) -> List[Dict[str, float]]:
+        plan = self._launch_plan()
+        if plan is not None:
+            return self._eval_via_launcher(plan, module, dataloaders,
+                                           datamodule, "validate")
         if datamodule is not None:
             datamodule.setup("validate")
             dataloaders = dataloaders or datamodule.val_dataloader()
@@ -953,6 +1036,10 @@ class Trainer:
 
     def test(self, module: TpuModule, dataloaders=None,
              datamodule=None) -> List[Dict[str, float]]:
+        plan = self._launch_plan()
+        if plan is not None:
+            return self._eval_via_launcher(plan, module, dataloaders,
+                                           datamodule, "test")
         if datamodule is not None:
             datamodule.setup("test")
             dataloaders = dataloaders or datamodule.test_dataloader()
@@ -960,6 +1047,10 @@ class Trainer:
 
     def predict(self, module: TpuModule, dataloaders=None,
                 datamodule=None) -> List[Any]:
+        plan = self._launch_plan()
+        if plan is not None:
+            return self._eval_via_launcher(plan, module, dataloaders,
+                                           datamodule, "predict")
         if datamodule is not None:
             datamodule.setup("predict")
             dataloaders = dataloaders or datamodule.predict_dataloader()
@@ -983,11 +1074,73 @@ class Trainer:
         in the same process (reference teardown: ray_ddp.py:109-121)."""
         self._train_step_fn = None
         self._eval_step_fn = None
+        self._test_step_fn = None
+        self._predict_step_fn = None
         self._state = None
         self._device_cache = None
         self._train_step_cached_fn = None
         self._epoch_scan_fn = None
+        # shardings hold live Mesh/Device objects -- they must not survive
+        # into a cloudpickled shipment (_strip_for_shipment -> teardown)
+        self._batch_sharding = None
+        self._state_shardings = None
+        self._idx_row_sharding = None
+        self._idx_mat_sharding = None
         self.accelerator.teardown()
+
+
+def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
+                        stage: str, process_id: int) -> Dict[str, Any]:
+    """Runs INSIDE each fanned-out worker for validate/test/predict
+    (the eval analog of ``_remote_fit_worker``; the reference rides the
+    same actor machinery for test, SURVEY.md §3.4).  validate/test compute
+    global-batch metrics SPMD (every rank returns the same numbers);
+    predict shards the loader with the strided eval sampler and returns
+    this rank's outputs for driver-side re-interleaving."""
+    os.environ["RLA_TPU_INSIDE_WORKER"] = "1"
+    if stage == "predict":
+        if datamodule is not None:
+            datamodule.setup("predict")
+            dataloaders = dataloaders or datamodule.predict_dataloader()
+        if isinstance(dataloaders, DataLoader) and \
+                trainer.accelerator.require_distributed_sampler:
+            dataloaders._inject_sampler(
+                shuffle=False,
+                **trainer.accelerator.distributed_sampler_kwargs())
+        outs = trainer.predict(module, dataloaders)
+        return {"outputs": [jax.tree.map(lambda x: np.asarray(x), o)
+                            for o in outs]}
+    if stage == "validate":
+        results = trainer.validate(module, dataloaders,
+                                   datamodule=datamodule)
+    else:
+        results = trainer.test(module, dataloaders, datamodule=datamodule)
+    metrics = {}
+    for k, v in trainer.callback_metrics.items():
+        try:
+            metrics[k] = float(v)
+        except (TypeError, ValueError):
+            pass
+    return {"metrics": metrics, "results": results}
+
+
+def _interleave_predictions(per_rank: List[List[Any]]) -> List[Any]:
+    """Merge per-rank predict outputs back into global dataset order.
+
+    The strided sampler gives rank r samples ``r, r+P, r+2P, ...``, so
+    local batch i element j is global sample ``(i*B + j)*P + r``: stacking
+    ranks on a new axis 1 and flattening restores global order, one merged
+    array per batch index.  (With drop_last=False and a ragged dataset the
+    sampler wraps -- padding duplicates survive here, same as torch's
+    DistributedSampler.)"""
+    if len(per_rank) == 1:
+        return per_rank[0]
+
+    def merge(*leaves):
+        stacked = np.stack(leaves, axis=1)  # (B, P, ...)
+        return stacked.reshape((-1,) + stacked.shape[2:])
+
+    return [jax.tree.map(merge, *parts) for parts in zip(*per_rank)]
 
 
 def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,

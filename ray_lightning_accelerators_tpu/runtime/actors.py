@@ -220,6 +220,13 @@ class Worker:
         if self._proc.is_alive():
             self._proc.terminate()
             self._proc.join(timeout=5)
+        if self._proc.is_alive():
+            # SIGTERM isn't fatal to every worker: jax.distributed installs
+            # a preemption notifier that CATCHES it (and gloo-wedged ranks
+            # sit in C++), so escalate -- a surviving child would hang the
+            # interpreter's exit join forever (mp joins daemons at exit)
+            self._proc.kill()
+            self._proc.join(timeout=5)
 
     def shutdown(self, timeout: float = 10.0) -> None:
         try:
@@ -237,7 +244,8 @@ def _set_env(key: str, value: str) -> None:
 
 
 def _node_ip() -> str:
-    return socket.gethostbyname(socket.gethostname())
+    from .net import node_ip
+    return node_ip()
 
 
 class ActorPool:

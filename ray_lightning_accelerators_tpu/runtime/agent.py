@@ -24,7 +24,10 @@ finishes (the agent relays the worker's raw result bytes without
 deserializing them -- driver-only classes never unpickle on the agent).
 
 Security note: agents execute arbitrary pickled callables, exactly like a
-Ray worker does.  Bind them to trusted networks only.
+Ray worker does.  The default bind is loopback; binding a wider interface
+should be paired with the shared-secret handshake (``RLA_TPU_AGENT_TOKEN``
+on both ends -- the analog of Ray's redis password): connections must send
+an ``auth`` frame with the token before any other op or they are refused.
 """
 
 from __future__ import annotations
@@ -42,6 +45,35 @@ from ..utils.logging import log
 
 _LEN = struct.Struct(">I")
 DEFAULT_PORT = 7777
+TOKEN_ENV = "RLA_TPU_AGENT_TOKEN"
+# the auth frame is RAW BYTES with this prefix, compared before ANY
+# cloudpickle.loads runs -- unpickling an unauthenticated frame would
+# itself be the RCE the token exists to prevent
+AUTH_MAGIC = b"RLA-TPU-AUTH1:"
+
+
+def _token_from_env() -> Optional[str]:
+    tok = os.environ.get(TOKEN_ENV, "")
+    return tok or None
+
+
+def check_auth_frame(raw: bytes, token: Optional[str]) -> Optional[bool]:
+    """Classify a connection's FIRST raw frame.
+
+    Returns True (valid auth frame / none required and frame is auth --
+    skip it), False (refuse: bad token, or token required and the frame
+    is not an auth frame), or None (no token required and this is a
+    normal data frame -- process it)."""
+    import hmac
+    if raw.startswith(AUTH_MAGIC):
+        if token is None:
+            return True  # open endpoint: accept and ignore the frame
+        return hmac.compare_digest(raw[len(AUTH_MAGIC):], token.encode())
+    return False if token is not None else None
+
+
+def auth_frame(token: str) -> bytes:
+    return AUTH_MAGIC + token.encode()
 
 
 # --------------------------------------------------------------------- #
@@ -52,11 +84,20 @@ def send_msg(sock: socket.socket, obj) -> None:
     sock.sendall(_LEN.pack(len(blob)) + blob)
 
 
-def recv_msg(sock: socket.socket):
-    """Read one frame; raises ConnectionError on EOF mid-frame."""
+def send_raw(sock: socket.socket, blob: bytes) -> None:
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def recv_raw(sock: socket.socket) -> bytes:
+    """Read one frame's raw bytes; raises ConnectionError on EOF."""
     header = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(header)
-    return cloudpickle.loads(_recv_exact(sock, n))
+    return _recv_exact(sock, n)
+
+
+def recv_msg(sock: socket.socket):
+    """Read one frame; raises ConnectionError on EOF mid-frame."""
+    return cloudpickle.loads(recv_raw(sock))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -70,7 +111,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _node_ip() -> str:
-    return socket.gethostbyname(socket.gethostname())
+    from .net import node_ip
+    return node_ip()
 
 
 def free_port() -> int:
@@ -86,7 +128,12 @@ class HostAgent:
     """One per machine.  Each accepted connection owns at most one worker
     subprocess (the driver opens one connection per remote worker)."""
 
-    def __init__(self, port: int = DEFAULT_PORT, bind: str = "0.0.0.0"):
+    def __init__(self, port: int = DEFAULT_PORT, bind: str = "127.0.0.1",
+                 token: Optional[str] = None):
+        # token: shared secret required from every connection before any
+        # other op; defaults to $RLA_TPU_AGENT_TOKEN so `rla-tpu agent` and
+        # driver pick it up symmetrically.  None + loopback bind = open.
+        self._token = token if token is not None else _token_from_env()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind, port))
@@ -126,6 +173,7 @@ class HostAgent:
 
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         worker: Optional[Worker] = None
+        first_frame = True
         send_lock = threading.Lock()  # execute replies come from callbacks
 
         def reply(req_id, status, payload) -> None:
@@ -138,9 +186,30 @@ class HostAgent:
         try:
             while True:
                 try:
-                    req_id, op, payload = recv_msg(conn)
+                    raw = recv_raw(conn)
                 except (ConnectionError, OSError):
                     return
+                if first_frame:
+                    # auth happens on RAW bytes, before any unpickling --
+                    # cloudpickle.loads of an untrusted frame IS code
+                    # execution, so a tokened agent never deserializes an
+                    # unauthenticated connection's data.  Refusals close
+                    # silently (a reply protocol would need the frame's
+                    # req_id, which only unpickling could produce).
+                    first_frame = False
+                    verdict = check_auth_frame(raw, self._token)
+                    if verdict is True:
+                        continue  # auth frame consumed
+                    if verdict is False:
+                        log.warning(
+                            "refused unauthenticated connection from %s "
+                            "(%s required)", addr, TOKEN_ENV)
+                        return
+                    # None: open agent, normal data frame -- fall through
+                try:
+                    req_id, op, payload = cloudpickle.loads(raw)
+                except BaseException:
+                    return  # malformed frame: drop the connection
                 try:
                     if op == "spawn":
                         rank, env = payload
@@ -211,8 +280,10 @@ def parse_address(address: str) -> Tuple[str, int]:
 class AgentConnection:
     """A single multiplexed request/response connection to a HostAgent."""
 
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(self, address: str, timeout: float = 30.0,
+                 token: Optional[str] = None):
         self.address = address
+        token = token if token is not None else _token_from_env()
         host, port = parse_address(address)
         # retry while the agent boots: "start agents, then the driver" is
         # the documented flow, and an agent importing jax takes seconds
@@ -229,6 +300,12 @@ class AgentConnection:
                 time_mod.sleep(0.25)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._token_sent = token is not None
+        if token is not None:
+            # raw-bytes handshake, fire-and-forget: the agent validates it
+            # before unpickling anything; a mismatch closes the connection
+            # (surfaced by the first op's ConnectionError)
+            send_raw(self._sock, auth_frame(token))
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
@@ -273,10 +350,14 @@ class AgentConnection:
                     self._closed = True
                     pending = list(self._pending.values())
                     self._pending.clear()
+                hint = ("" if self._token_sent else
+                        f" (if the agent requires {TOKEN_ENV}, export it "
+                        f"on the driver too)")
                 for fut in pending:
                     if not fut.done():
                         fut.set_exception(ConnectionError(
-                            f"lost connection to agent {self.address}"))
+                            f"lost connection to agent "
+                            f"{self.address}{hint}"))
                 return
             with self._state_lock:
                 fut = self._pending.pop(req_id, None)
@@ -379,17 +460,48 @@ def agents_from_env() -> Optional[List[str]]:
     return [a.strip() for a in raw.split(",") if a.strip()] or None
 
 
+def parse_agent_spec(spec: str) -> Tuple[str, Optional[int]]:
+    """``"host:port*3"`` -> ``("host:port", 3)``; bare address -> count None
+    (count decided by the balanced split)."""
+    addr, star, count = spec.partition("*")
+    return addr.strip(), int(count) if star else None
+
+
 def assign_agents(agents: Sequence[str], num_workers: int) -> List[str]:
     """Contiguous block assignment: worker i's agent.  Blocks keep each
     host's workers adjacent so global rank order groups by host (the
-    local-rank census stays meaningful, reference: ray_ddp.py:132-143)."""
+    local-rank census stays meaningful, reference: ray_ddp.py:132-143).
+
+    Layouts need not be even (the reference places actors wherever
+    resources exist, ray_ddp.py:92-97): a balanced split gives the first
+    ``num_workers % n_agents`` hosts one extra worker (3 over 2 -> 2+1),
+    and explicit per-host capacities can be pinned with ``host:port*N``
+    specs (then the counts must sum to ``num_workers``)."""
     n_agents = len(agents)
-    if num_workers % n_agents != 0:
-        raise ValueError(
-            f"num_workers={num_workers} must be divisible by the number "
-            f"of agents ({n_agents}) for an even per-host layout")
-    per = num_workers // n_agents
-    return [agents[i // per] for i in range(num_workers)]
+    if n_agents == 0 or num_workers < 1:
+        raise ValueError("need at least one agent and one worker")
+    parsed = [parse_agent_spec(a) for a in agents]
+    addrs = [a for a, _ in parsed]
+    counts = [c for _, c in parsed]
+    if any(c is not None for c in counts):
+        if any(c is None for c in counts):
+            raise ValueError(
+                "mix of explicit (host:port*N) and bare agent specs; "
+                "give every agent a count or none")
+        if any(c < 0 for c in counts):
+            raise ValueError(f"negative worker count in agent specs: "
+                             f"{list(agents)}")
+        if sum(counts) != num_workers:
+            raise ValueError(
+                f"explicit agent worker counts {counts} sum to "
+                f"{sum(counts)}, but num_workers={num_workers}")
+    else:
+        base, extra = divmod(num_workers, n_agents)
+        counts = [base + (1 if i < extra else 0) for i in range(n_agents)]
+    assignment: List[str] = []
+    for addr, count in zip(addrs, counts):
+        assignment.extend([addr] * count)
+    return assignment
 
 
 def coordinator_address_on(agent_address: str) -> str:

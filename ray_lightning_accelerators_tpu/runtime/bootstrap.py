@@ -31,7 +31,8 @@ from .queue import QueueServer, TrampolineQueue, process_results
 def pick_coordinator_address(port: Optional[int] = None) -> str:
     """ip:port rendezvous string (reference setup_address analog,
     ray_ddp.py:10,162-163)."""
-    ip = socket.gethostbyname(socket.gethostname())
+    from .net import node_ip
+    ip = node_ip()
     if port is None:
         with socket.socket() as s:
             s.bind(("", 0))
@@ -81,8 +82,8 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
     """
     for attempt in range(3):
         if agents:
-            from .agent import coordinator_address_on
-            coord = coordinator_address_on(agents[0])
+            from .agent import coordinator_address_on, parse_agent_spec
+            coord = coordinator_address_on(parse_agent_spec(agents[0])[0])
         else:
             coord = pick_coordinator_address()
 
@@ -96,14 +97,24 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
                         queue_address=queue_address) -> Any:
             initialize_worker(coord, num_processes, process_id, platform,
                               cpu_devices_per_process)
+            client = None
             if queue_address is not None:
                 from . import session as session_lib
                 from .queue import QueueClient
-                session_lib.init_session(process_id,
-                                         QueueClient(queue_address))
-            if init_hook is not None:
-                init_hook()
-            return trainable(process_id)
+                client = QueueClient(queue_address)
+                session_lib.init_session(process_id, client)
+            try:
+                if init_hook is not None:
+                    init_hook()
+                return trainable(process_id)
+            finally:
+                # the result travels the worker pipe while queued thunks
+                # travel a separate TCP connection: without this barrier the
+                # driver's final drain can run before the server enqueues
+                # the last thunks, dropping tune reports (mirrors
+                # _process_trial_main in tune/run.py)
+                if client is not None:
+                    client.flush()
 
         pool: Optional[ActorPool] = None
         try:

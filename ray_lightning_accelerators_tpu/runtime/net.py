@@ -1,0 +1,37 @@
+"""Routable-IP discovery shared by agents, workers, and the bootstrap.
+
+The reference leans on Ray's ``get_node_ip_address`` which probes with a
+routable UDP socket; ``socket.gethostbyname(socket.gethostname())`` is not
+equivalent -- on common Debian/Ubuntu ``/etc/hosts`` layouts it resolves to
+``127.0.1.1``, and that value gets advertised cross-machine as the
+jax.distributed coordinator / queue-server address, making the rendezvous
+unreachable from other hosts.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def node_ip() -> str:
+    """This host's routable IP.
+
+    UDP-connect probe first (no packets are sent -- connect() on a datagram
+    socket only runs the routing lookup), falling back to
+    ``gethostbyname(gethostname())`` and finally loopback for hosts with no
+    route at all (air-gapped CI).
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    finally:
+        s.close()
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"

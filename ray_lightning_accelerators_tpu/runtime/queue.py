@@ -52,13 +52,18 @@ class QueueServer:
     ``(rank, thunk)`` frames; a reader thread per connection deserializes
     and enqueues locally."""
 
-    def __init__(self, queue: TrampolineQueue, bind: str = "0.0.0.0"):
+    def __init__(self, queue: TrampolineQueue, bind: str = "0.0.0.0",
+                 query_handler=None):
         import socket as socket_mod
 
-        from .agent import _node_ip, recv_msg
+        from .agent import _node_ip, _token_from_env
 
         self._queue = queue
-        self._recv_msg = recv_msg
+        self._token = _token_from_env()  # fixed at construction
+        # optional request/response channel riding the same socket: workers
+        # can ASK the driver something (e.g. "was my trial STOPped?") --
+        # handler(name, payload) -> result, run on the reader thread
+        self._query_handler = query_handler
         self._srv = socket_mod.socket(socket_mod.AF_INET,
                                       socket_mod.SOCK_STREAM)
         self._srv.setsockopt(socket_mod.SOL_SOCKET,
@@ -82,22 +87,60 @@ class QueueServer:
                              daemon=True).start()
 
     def _reader(self, conn) -> None:
-        from .agent import send_msg
+        import cloudpickle
+
+        from .agent import check_auth_frame, recv_raw, send_msg
+
+        # same shared-secret contract as HostAgent: queued thunks EXECUTE
+        # driver-side, so the FIRST frame is auth-checked on RAW bytes
+        # before any unpickling.  A token-less server skips a leading auth
+        # frame (tokened workers talking to an open driver); a tokened
+        # server drops anything unauthenticated.
+        def close():
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+        first_frame = True
         while True:
             try:
-                item = self._recv_msg(conn)
+                raw = recv_raw(conn)
             except (ConnectionError, OSError):
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                close()
                 return
+            if first_frame:
+                first_frame = False
+                verdict = check_auth_frame(raw, self._token)
+                if verdict is True:
+                    continue  # auth frame consumed
+                if verdict is False:
+                    close()
+                    return
+            try:
+                item = cloudpickle.loads(raw)
+            except BaseException:
+                close()
+                return  # malformed frame: drop the connection
             if isinstance(item, tuple) and len(item) == 2 \
                     and item[0] == "__rla_ack__":
                 # flush barrier: everything this client sent earlier is
                 # already enqueued locally (same reader thread, in order)
                 try:
                     send_msg(conn, item)
+                except OSError:
+                    pass
+                continue
+            if isinstance(item, tuple) and len(item) == 3 \
+                    and item[0] == "__rla_query__":
+                _tag, name, payload = item
+                try:
+                    result = (None if self._query_handler is None
+                              else self._query_handler(name, payload))
+                except Exception:
+                    result = None  # a broken handler must not kill the pump
+                try:
+                    send_msg(conn, ("__rla_query__", result))
                 except OSError:
                     pass
                 continue
@@ -124,6 +167,10 @@ class QueueClient:
                                                   timeout=30)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
+        from .agent import _token_from_env, auth_frame, send_raw
+        token = _token_from_env()
+        if token is not None:
+            send_raw(self._sock, auth_frame(token))
 
     def put(self, item) -> None:
         from .agent import send_msg
@@ -140,6 +187,16 @@ class QueueClient:
         with self._lock:
             send_msg(self._sock, ("__rla_ack__", 0))
             recv_msg(self._sock)
+
+    def query(self, name: str, payload=None):
+        """Ask the driver's query handler something; blocks for the reply.
+        The lock serializes queries with puts/flushes, so the next frame
+        received is this query's response."""
+        from .agent import recv_msg, send_msg
+        with self._lock:
+            send_msg(self._sock, ("__rla_query__", name, payload))
+            _tag, result = recv_msg(self._sock)
+            return result
 
     def empty(self) -> bool:
         return True

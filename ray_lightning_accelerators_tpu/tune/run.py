@@ -125,9 +125,24 @@ def get_trial_session() -> _TrialSession:
 
 def trial_should_stop() -> bool:
     """True when the active trial was STOPped by a scheduler; the Tune
-    callbacks poll this and end training cleanly via trainer.should_stop."""
+    callbacks poll this and end training cleanly via trainer.should_stop.
+
+    Inside a PROCESS-isolated trial there is no local trial session; the
+    scheduler's decision lives driver-side, so the poll crosses the
+    network queue's query channel (the stop analog of the report
+    trampoline)."""
     s = _current_session()
-    return s is not None and s.trial.should_stop
+    if s is not None:
+        return s.trial.should_stop
+    if session_lib.session_exists():
+        sess = session_lib.get_session()
+        q = getattr(sess, "_queue", None)
+        if hasattr(q, "query"):
+            try:
+                return bool(q.query("should_stop", sess.rank))
+            except BaseException:
+                return False  # driver gone; the trial will fail on its own
+    return False
 
 
 def trial_devices() -> Optional[list]:
@@ -280,22 +295,44 @@ def _process_trial_main(trainable, config, queue_address, trial_rank):
 def _run_trials_in_processes(trainable, trials, scheduler,
                              max_concurrent: int,
                              raise_on_failed_trial: bool, verbose: int,
-                             trial_env: Optional[Dict[str, str]]):
+                             trial_env: Optional[Dict[str, str]],
+                             agents: Optional[List[str]] = None):
     """One fresh worker subprocess per trial (the reference's trial
     isolation: Tune trials are separate processes,
     examples/ray_ddp_example.py:101-113).  A trial that hard-crashes
     (os._exit, fatal XLA error) is recorded as ERROR; the experiment
     continues.  Thunks carry the trial's rank, and the drain binds that
     trial's session before executing, so concurrent trials can't
-    cross-report."""
+    cross-report.
+
+    ``agents``: HostAgent addresses -- trial subprocesses place
+    round-robin across the hosts (the reference's trials-anywhere-on-the-
+    cluster placement, reference: examples/ray_ddp_example.py:101-113),
+    with reports/checkpoints/stop-polls riding the network queue."""
     import time as time_mod
 
     from ..runtime.actors import Worker
     from ..runtime.queue import QueueServer, TrampolineQueue
 
-    q = TrampolineQueue()
-    server = QueueServer(q)
     sessions = {i: _TrialSession(t, scheduler) for i, t in enumerate(trials)}
+
+    def _query(name, payload):
+        # worker-side trial_should_stop() polls land here (reader thread);
+        # reading the bool the drain thread sets is atomic under the GIL
+        if name == "should_stop":
+            s = sessions.get(payload)
+            return bool(s is not None and s.trial.should_stop)
+        return None
+
+    q = TrampolineQueue()
+    server = QueueServer(q, query_handler=_query)
+
+    def _spawn_worker(i: int):
+        if agents:
+            from ..runtime.agent import RemoteWorker, parse_agent_spec
+            addr = parse_agent_spec(agents[i % len(agents)])[0]
+            return RemoteWorker(addr, i, dict(trial_env or {}))
+        return Worker(i, dict(trial_env or {}))
 
     def drain() -> None:
         while True:
@@ -328,7 +365,19 @@ def _run_trials_in_processes(trainable, trials, scheduler,
             while queue_idx and len(pending) < max_concurrent:
                 i = queue_idx.pop(0)
                 trials[i].status = "RUNNING"
-                w = Worker(i, dict(trial_env or {}))
+                try:
+                    w = _spawn_worker(i)
+                except BaseException as e:
+                    # an unreachable agent fails THIS trial, not the whole
+                    # experiment (same containment as a trial crash)
+                    trials[i].status = "ERROR"
+                    trials[i].error = e
+                    log.warning("trial %s failed to place: %s",
+                                trials[i].trial_id, e)
+                    if raise_on_failed_trial:
+                        failures.append(e)
+                        queue_idx.clear()
+                    continue
                 fut = w.execute(_process_trial_main, trainable,
                                 trials[i].config, server.address, i)
                 pending[i] = (w, fut)
@@ -384,6 +433,7 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         devices_per_trial: Optional[int] = None,
         trial_executor: str = "thread",
         trial_env: Optional[Dict[str, str]] = None,
+        agents: Optional[List[str]] = None,
         **_compat_kwargs) -> ExperimentAnalysis:
     """Run `trainable(config)` for every sampled/grid config.
 
@@ -401,8 +451,15 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
     ``os.cpu_count() // (cpu + extra_cpu)`` trials run at once.
     `scheduler` is a tune.schedulers.TrialScheduler (e.g. ASHAScheduler)
     consulted on every reported result; its STOP decisions end trials
-    early and mark them STOPPED (thread executor; process trials record
-    the decision but run to completion).
+    early and mark them STOPPED (process trials poll the decision over
+    the network queue's query channel and stop at the next report
+    boundary).
+
+    ``agents``: with ``trial_executor="process"``, HostAgent addresses
+    (defaults to ``RLA_TPU_AGENTS``) to place trial subprocesses
+    round-robin across cluster hosts -- the reference's
+    trials-anywhere-on-the-cluster placement
+    (examples/ray_ddp_example.py:101-113).
 
     ``max_concurrent_trials > 1`` runs trials in parallel over disjoint
     device partitions — the trials x workers-per-trial parallelism the
@@ -436,6 +493,9 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
         configs = generate_trial_configs(config, num_samples, seed)
 
     if trial_executor == "process":
+        if agents is None:
+            from ..runtime.agent import agents_from_env
+            agents = agents_from_env()
         trials = [Trial(f"trial_{i:05d}", cfg, exp_dir)
                   for i, cfg in enumerate(configs)]
         concurrent = max(1, max_concurrent_trials)
@@ -449,7 +509,8 @@ def run(trainable: Callable[[Dict[str, Any]], Any],
                             os.cpu_count() or 1, per)
             concurrent = min(concurrent, cap)
         _run_trials_in_processes(trainable, trials, scheduler, concurrent,
-                                 raise_on_failed_trial, verbose, trial_env)
+                                 raise_on_failed_trial, verbose, trial_env,
+                                 agents=agents)
         return ExperimentAnalysis(trials, metric, mode)
 
     if max_concurrent_trials > 1:

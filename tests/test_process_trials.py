@@ -96,6 +96,69 @@ def test_trainer_with_checkpoint_callback_in_process_trial(tmp_path):
     assert str(tmp_path) in best  # written under the DRIVER's trial dir
 
 
+def _loopy_trial(config):
+    """Reports up to 12 times, polling the scheduler's stop decision
+    between reports (the decision lives driver-side; in a process trial
+    the poll crosses the network queue's query channel)."""
+    import time
+
+    from ray_lightning_accelerators_tpu import tune as tune_mod
+
+    for _ in range(12):
+        tune_mod.report(loss=config["loss"])
+        time.sleep(0.15)  # let the driver drain + decide
+        if tune_mod.trial_should_stop():
+            return "stopped"
+    return "completed"
+
+
+def test_scheduler_stop_ends_process_trial_early(tmp_path):
+    """An ASHA STOP actually ends a process-isolated trial early (round-2
+    weak #5: the decision was recorded but the trial burned its full
+    budget)."""
+    sched = tune.ASHAScheduler(metric="loss", mode="min",
+                               grace_period=2, reduction_factor=2)
+    analysis = tune.run(_loopy_trial,
+                        config={"loss": tune.grid_search([0.1, 1.0])},
+                        num_samples=1, metric="loss", mode="min",
+                        local_dir=str(tmp_path), scheduler=sched,
+                        trial_executor="process", trial_env=_ENV)
+    by_loss = {t.config["loss"]: t for t in analysis.trials}
+    good, bad = by_loss[0.1], by_loss[1.0]
+    assert good.status == "TERMINATED"
+    assert good.training_iteration == 12
+    assert bad.status == "STOPPED"
+    assert bad.training_iteration < 8  # ended well short of its budget
+
+
+def test_process_trials_over_agents(tmp_path):
+    """Trial subprocesses place round-robin over host agents (the
+    reference's trials-anywhere-on-the-cluster placement); a crashed trial
+    is contained as ERROR while the experiment completes."""
+    from ray_lightning_accelerators_tpu.runtime.agent import HostAgent
+
+    hosts = [HostAgent(port=0, bind="127.0.0.1") for _ in range(2)]
+    for a in hosts:
+        a.serve_in_background()
+    addrs = [f"127.0.0.1:{a.port}" for a in hosts]
+    try:
+        analysis = tune.run(
+            _crash_or_report,
+            config={"x": tune.grid_search([1.0, 2.0, 0.5, 0.7])},
+            num_samples=1, metric="loss", mode="min",
+            local_dir=str(tmp_path), raise_on_failed_trial=False,
+            trial_executor="process", trial_env=_ENV, agents=addrs)
+        by_x = {t.config["x"]: t for t in analysis.trials}
+        assert by_x[2.0].status == "ERROR"
+        assert by_x[2.0].error is not None
+        for x in (1.0, 0.5, 0.7):
+            assert by_x[x].status == "TERMINATED", x
+        assert analysis.best_config["x"] == 0.5
+    finally:
+        for a in hosts:
+            a.shutdown()
+
+
 def test_resources_per_trial_caps_concurrency(tmp_path):
     # cpu request exceeding the host -> capped to 1, still completes
     analysis = tune.run(_report_pid,
