@@ -159,6 +159,17 @@ class Trainer:
         self._device_cache = None
         self._train_step_cached_fn = None
         self._epoch_scan_fn = None
+        # persistent fan-out world (spawned agent workers + formed
+        # jax.distributed world), reused across entry points; see
+        # _acquire_world / shutdown_workers
+        self._world = None
+
+    def __getstate__(self):
+        """The fan-out ships this trainer to workers; the live world
+        (processes, sockets, threads) stays driver-side."""
+        state = dict(self.__dict__)
+        state["_world"] = None
+        return state
 
     # ------------------------------------------------------------------ #
     # Checkpoint plumbing                                                #
@@ -300,7 +311,8 @@ class Trainer:
             eval_step, in_shardings=(state_sh.params, batch_sh))
         self._test_step_fn = jax.jit(
             test_step, in_shardings=(state_sh.params, batch_sh))
-        self._predict_step_fn = jax.jit(predict_step)
+        self._predict_step_fn = jax.jit(
+            predict_step, in_shardings=(state_sh.params, batch_sh))
         self._batch_sharding = batch_sh
         self._state_shardings = state_sh
 
@@ -584,7 +596,7 @@ class Trainer:
             module.params = jax.tree.map(
                 lambda x: np.asarray(jax.device_get(x)), module.params)
         module.trainer = None  # rebound worker-side and on return
-        self.teardown()
+        self._release_compiled_state()
         self._mesh = None
         self._val_loader = None
         if getattr(module, "mesh", None) is not None:
@@ -592,26 +604,70 @@ class Trainer:
         if hasattr(module, "_jit_predict"):
             del module._jit_predict
 
+    def _acquire_world(self, spec):
+        """The persistent fan-out world for ``spec``: reused across
+        fit/validate/test/predict (workers spawn ONCE, the
+        jax.distributed world forms once -- the reference's actors live
+        for the whole setup->teardown span, ray_ddp.py:99-121); respawned
+        only when the spec changed or a prior run poisoned it.  Acquired
+        BEFORE ``_strip_for_shipment``, so an unreachable agent raises
+        while the driver's module/trainer are still intact."""
+        from ..runtime.bootstrap import DistributedWorld
+
+        n = spec["num_processes"]
+        env, platform, cpu_per = self._spawn_platform(spec)
+        key = (n, platform, cpu_per, tuple(sorted(env.items())),
+               tuple(spec.get("agents") or ()))
+        world = self._world
+        if world is not None and (world.spec != key or not world.alive()):
+            world.shutdown()
+            world = self._world = None
+        if world is None:
+            world = DistributedWorld(n, platform, cpu_per, env,
+                                     spec.get("agents"))
+            self._world = world
+        return world
+
+    def _run_in_world(self, world, module, body, queue):
+        """One entry-point run over the persistent world.  A failed run
+        poisons the world's collectives (DistributedWorld kills itself);
+        re-bind the stripped driver objects so the caller's trainer/module
+        still work locally afterwards."""
+        try:
+            return world.run(body, queue=queue)
+        except BaseException:
+            self._world = None
+            module.trainer = self
+            self.module = module
+            self.fitting = False
+            raise
+
+    def shutdown_workers(self) -> None:
+        """End the persistent fan-out world (spawned agent workers + their
+        jax.distributed world).  The explicit end of the reference's
+        actor lifecycle (ray_ddp.py:109-121); idle worlds otherwise live
+        until the driver process exits."""
+        if self._world is not None:
+            self._world.shutdown()
+            self._world = None
+
     def _fit_via_launcher(self, spec, module, train_dataloaders,
                           val_dataloaders, datamodule, ckpt_path) -> None:
         import functools
 
-        from ..runtime.bootstrap import launch_distributed
         from ..runtime.queue import TrampolineQueue
 
         n = spec["num_processes"]
-        env, platform, cpu_per = self._spawn_platform(spec)
         log.warning("fanning fit out to %d processes via agents %s",
                     n, spec.get("agents"))
+        world = self._acquire_world(spec)
         self._strip_for_shipment(module)
 
         queue = TrampolineQueue()
         body = functools.partial(_remote_fit_worker, self, module,
                                  train_dataloaders, val_dataloaders,
                                  datamodule, ckpt_path)
-        results = launch_distributed(
-            body, n, platform=platform, cpu_devices_per_process=cpu_per,
-            env=env, agents=spec.get("agents"), queue=queue)
+        results = self._run_in_world(world, module, body, queue)
 
         # re-hydrate rank-0 state into the driver's trainer + module
         # (reference: ray_ddp.py:185-193)
@@ -644,27 +700,25 @@ class Trainer:
         shard re-interleave into global dataset order."""
         import functools
 
-        from ..runtime.bootstrap import launch_distributed
         from ..runtime.queue import TrampolineQueue
 
         n = spec["num_processes"]
-        env, platform, cpu_per = self._spawn_platform(spec)
         log.warning("fanning %s out to %d processes via agents %s",
                     stage, n, spec.get("agents"))
+        world = self._acquire_world(spec)
         self._strip_for_shipment(module)
 
         queue = TrampolineQueue()
         body = functools.partial(_remote_eval_worker, self, module,
                                  dataloaders, datamodule, stage)
-        results = launch_distributed(
-            body, n, platform=platform, cpu_devices_per_process=cpu_per,
-            env=env, agents=spec.get("agents"), queue=queue)
+        results = self._run_in_world(world, module, body, queue)
 
         module.trainer = self
         self.module = module
         if stage == "predict":
             return _interleave_predictions(
-                [r["outputs"] for r in results])
+                [r["outputs"] for r in results],
+                total=results[0].get("dataset_len"))
         r0 = results[0]
         self.callback_metrics.update(r0["metrics"])
         return r0["results"]
@@ -983,8 +1037,12 @@ class Trainer:
             weights += n
         return {k: v / max(weights, 1.0) for k, v in sums.items()}
 
-    def _eval_entry(self, module, dataloaders, step_fn_name: str,
-                    stage: str) -> List[Dict[str, float]]:
+    def _ensure_eval_state(self, module, dataloaders, stage: str):
+        """Bind the module, build the mesh, inject the eval sampler, and
+        make sure compiled step fns + a sharded state exist (compiling
+        from the module's params when this trainer never fit).  Returns
+        the loader to iterate: a one-shot iterable is materialized first,
+        because the compile probe consumes its head batch."""
         # A different module (or one whose params were swapped after fit)
         # must be evaluated on ITS weights, not a stale fit state.
         if self._state is not None and module is not self.module:
@@ -1005,9 +1063,17 @@ class Trainer:
             self._tx = self._build_tx(module)
             state = TrainState.create(module.params, self._tx,
                                       rng_from_seed(self.seed))
+            if not isinstance(dataloaders, DataLoader) and \
+                    not hasattr(dataloaders, "__len__"):
+                dataloaders = list(dataloaders)  # one-shot iterable
             example = next(iter(dataloaders))
             self._compile(module, state, example)
             self._state = jax.device_put(state, self._state_shardings)
+        return dataloaders
+
+    def _eval_entry(self, module, dataloaders, step_fn_name: str,
+                    stage: str) -> List[Dict[str, float]]:
+        dataloaders = self._ensure_eval_state(module, dataloaders, stage)
         step_fn = getattr(self, step_fn_name)
         if stage == "validate":
             for c in self.callbacks:
@@ -1054,24 +1120,48 @@ class Trainer:
         if datamodule is not None:
             datamodule.setup("predict")
             dataloaders = dataloaders or datamodule.predict_dataloader()
-        self.module = module
-        module.trainer = self
-        self.accelerator.setup_environment()
-        self._mesh = self.accelerator.build_mesh()
-        params = (self._state.params if self._state is not None
-                  else module.params)
-        if params is None:
-            raise RuntimeError("predict() before fit(): module has no params")
-        predict = jax.jit(module.predict_step)
+        if jax.process_count() > 1:
+            # inside a fanned-out world each rank predicts its OWN strided
+            # sampler shard locally (outputs must stay fully addressable
+            # for the driver-side re-interleave); the global batch
+            # sharding below would misread the local shard as the whole
+            # batch and produce non-addressable outputs
+            self.module = module
+            module.trainer = self
+            self.accelerator.setup_environment()
+            self._mesh = self.accelerator.build_mesh()
+            params = (self._state.params if self._state is not None
+                      else module.params)
+            if params is None:
+                raise RuntimeError(
+                    "predict() before fit(): module has no params")
+            predict = jax.jit(module.predict_step)
+            return [jax.device_get(predict(params, batch))
+                    for batch in dataloaders]
+        # single process: same mesh-aware path as every other stage -- the
+        # batch lands with _batch_sharding (data-axis sharded on a
+        # multi-device mesh) and runs through the compiled
+        # _predict_step_fn, so an 8-device trainer predicts on all 8
+        dataloaders = self._ensure_eval_state(module, dataloaders, "predict")
+        params = self._state.params
         outs = []
         for batch in dataloaders:
-            outs.append(jax.device_get(predict(params, batch)))
+            batch = self._put_batch(batch)
+            outs.append(jax.device_get(self._predict_step_fn(params, batch)))
         return outs
 
     # ------------------------------------------------------------------ #
     def teardown(self) -> None:
-        """Release compiled functions + device state so a fresh fit can run
-        in the same process (reference teardown: ray_ddp.py:109-121)."""
+        """Full release: compiled functions + device state (so a fresh fit
+        can run in the same process) AND the persistent fan-out world --
+        the reference's teardown ends its actors too
+        (ray_ddp.py:109-121)."""
+        self._release_compiled_state()
+        self.shutdown_workers()
+
+    def _release_compiled_state(self) -> None:
+        """Device-state half of teardown(), used by _strip_for_shipment --
+        which must NOT end the world it just acquired."""
         self._train_step_fn = None
         self._eval_step_fn = None
         self._test_step_fn = None
@@ -1109,7 +1199,12 @@ def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
                 **trainer.accelerator.distributed_sampler_kwargs())
         outs = trainer.predict(module, dataloaders)
         return {"outputs": [jax.tree.map(lambda x: np.asarray(x), o)
-                            for o in outs]}
+                            for o in outs],
+                # true dataset length, so the driver can drop the strided
+                # sampler's wrap-padding after re-interleaving
+                "dataset_len": (len(dataloaders.dataset)
+                                if isinstance(dataloaders, DataLoader)
+                                else None)}
     if stage == "validate":
         results = trainer.validate(module, dataloaders,
                                    datamodule=datamodule)
@@ -1124,23 +1219,41 @@ def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
     return {"metrics": metrics, "results": results}
 
 
-def _interleave_predictions(per_rank: List[List[Any]]) -> List[Any]:
+def _interleave_predictions(per_rank: List[List[Any]],
+                            total: Optional[int] = None) -> List[Any]:
     """Merge per-rank predict outputs back into global dataset order.
 
     The strided sampler gives rank r samples ``r, r+P, r+2P, ...``, so
     local batch i element j is global sample ``(i*B + j)*P + r``: stacking
     ranks on a new axis 1 and flattening restores global order, one merged
-    array per batch index.  (With drop_last=False and a ragged dataset the
-    sampler wraps -- padding duplicates survive here, same as torch's
-    DistributedSampler.)"""
-    if len(per_rank) == 1:
-        return per_rank[0]
+    array per batch index.
 
-    def merge(*leaves):
-        stacked = np.stack(leaves, axis=1)  # (B, P, ...)
-        return stacked.reshape((-1,) + stacked.shape[2:])
+    ``total``: the true dataset length.  With drop_last=False and
+    ``len(dataset) % P != 0`` the sampler wraps, so the merged stream ends
+    in padding duplicates; truncating to ``total`` makes driver-mode
+    predict() return exactly the single-process result (PTL drops padded
+    duplicates for predict the same way)."""
+    merged = (per_rank[0] if len(per_rank) == 1 else None)
+    if merged is None:
 
-    return [jax.tree.map(merge, *parts) for parts in zip(*per_rank)]
+        def merge(*leaves):
+            stacked = np.stack(leaves, axis=1)  # (B, P, ...)
+            return stacked.reshape((-1,) + stacked.shape[2:])
+
+        merged = [jax.tree.map(merge, *parts) for parts in zip(*per_rank)]
+    if total is None:
+        return merged
+    out: List[Any] = []
+    seen = 0
+    for batch in merged:
+        n = np.shape(jax.tree.leaves(batch)[0])[0]
+        take = min(n, total - seen)
+        if take <= 0:
+            break
+        out.append(batch if take == n
+                   else jax.tree.map(lambda x: x[:take], batch))
+        seen += take
+    return out
 
 
 def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,

@@ -141,6 +141,9 @@ class HostAgent:
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # observability: total worker subprocesses ever spawned -- a driver
+        # whose world persists across entry points spawns each rank ONCE
+        self.spawn_count = 0
 
     def serve_forever(self) -> None:
         log.warning("rla-tpu agent listening on %s:%d", _node_ip(),
@@ -214,6 +217,7 @@ class HostAgent:
                     if op == "spawn":
                         rank, env = payload
                         worker = Worker(rank, env)
+                        self.spawn_count += 1
                         reply(req_id, "ok", None)
                     elif op == "execute":
                         fut = worker.execute_blob(payload, raw=True)
@@ -280,9 +284,14 @@ def parse_address(address: str) -> Tuple[str, int]:
 class AgentConnection:
     """A single multiplexed request/response connection to a HostAgent."""
 
-    def __init__(self, address: str, timeout: float = 30.0,
+    def __init__(self, address: str, timeout: Optional[float] = None,
                  token: Optional[str] = None):
         self.address = address
+        if timeout is None:
+            # how long to keep retrying an unreachable agent (boot grace);
+            # tests / fail-fast deployments shrink it via env
+            timeout = float(os.environ.get("RLA_TPU_AGENT_CONNECT_TIMEOUT",
+                                           30.0))
         token = token if token is not None else _token_from_env()
         host, port = parse_address(address)
         # retry while the agent boots: "start agents, then the driver" is

@@ -59,6 +59,232 @@ def initialize_worker(coordinator_address: str, num_processes: int,
                                process_id=process_id)
 
 
+def _nested_query_handler() -> Optional[Callable[[str, Any], Any]]:
+    """Query handler for a fit-level QueueServer: workers inside THIS fit
+    may poll tune state ("should_stop", synchronous "report"/"checkpoint")
+    that lives one level up -- with the fit nested in a tune process
+    trial, the decision is on the TUNE driver, reachable through this
+    process's own session QueueClient.  Forwards those queries upward,
+    re-stamping the inner worker's fit rank with this process's trial
+    rank; answers directly when a tune trial session lives right here
+    (sequential thread-executor trials).  Returns None (no handler) when
+    there is nothing to answer from this process."""
+    def handler(name: str, payload: Any) -> Any:
+        try:
+            from ..tune import run as tune_run
+            s = tune_run._current_session()
+        except Exception:
+            s = None
+        if s is not None:
+            # one dispatch shared with the tune driver's own QueueServer;
+            # inner fit ranks all resolve to THIS process's trial session
+            return tune_run.dispatch_trial_query(name, payload,
+                                                 lambda _rank: s)
+        from . import session as session_lib
+        if not session_lib.session_exists():
+            return None
+        sess = session_lib.get_session()
+        q = getattr(sess, "_queue", None)
+        if not hasattr(q, "query"):
+            return None
+        if name in ("report", "checkpoint"):
+            return q.query(name, (sess.rank,) + tuple(payload[1:]))
+        return q.query(name, sess.rank)
+    return handler
+
+
+def _run_world_body(process_id: int, trainable, queue_address, init_hook):
+    """One entry-point run inside a (persistent) worker: fresh session
+    bound to this run's queue, trainable, flush barrier."""
+    from . import session as session_lib
+
+    # persistent workers run many bodies; each run binds a fresh session
+    # to ITS driver queue (and a queue-less run must not inherit a stale
+    # client from the previous one)
+    session_lib.shutdown_session()
+    client = None
+    if queue_address is not None:
+        from .queue import QueueClient
+        client = QueueClient(queue_address)
+        session_lib.init_session(process_id, client)
+    try:
+        if init_hook is not None:
+            init_hook()
+        return trainable(process_id)
+    finally:
+        # the result travels the worker pipe while queued thunks travel a
+        # separate TCP connection: without this barrier the driver's final
+        # drain can run before the server enqueues the last thunks,
+        # dropping tune reports (mirrors _process_trial_main in
+        # tune/run.py).  A dead driver/queue here must not mask the body's
+        # real exception (e.g. a crashed peer already tore the server
+        # down).
+        if client is not None:
+            try:
+                client.flush()
+            except (ConnectionError, OSError):
+                pass
+            client.shutdown()
+
+
+class DistributedWorld:
+    """Persistent fan-out world: spawned worker processes with a formed
+    ``jax.distributed`` world, reusable across entry points
+    (fit -> validate -> test -> predict) without respawning workers,
+    re-forming the world, or recompiling from a cold runtime.
+
+    The reference keeps its Ray actors alive for the accelerator's whole
+    ``setup()`` -> ``teardown()`` span and routes every stage through them
+    (reference: ray_lightning/ray_ddp.py:99-121); this is that lifecycle
+    for agent workers.  Construction spawns the pool and forms the world
+    (so an unreachable agent fails HERE, before any driver state is
+    mutated); ``run`` executes one trainable over the live world; a failed
+    run poisons the collectives, so the world kills itself and ``alive``
+    turns False.
+    """
+
+    def __init__(self, num_processes: int,
+                 platform: Optional[str] = None,
+                 cpu_devices_per_process: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 agents: Optional[Sequence[str]] = None):
+        self.num_processes = num_processes
+        self.agents = list(agents) if agents else None
+        self.spec = (num_processes, platform, cpu_devices_per_process,
+                     tuple(sorted((env or {}).items())),
+                     tuple(self.agents or ()))
+        self.pool: Optional[ActorPool] = None
+        # the probe-then-close port pick has an inherent reuse window
+        # (another process can claim the freed port before rank 0's
+        # coordinator binds it); bind failures retry with a fresh port
+        # rather than surfacing as an unattributable rendezvous hang
+        for attempt in range(3):
+            if self.agents:
+                from .agent import coordinator_address_on, parse_agent_spec
+                coord = coordinator_address_on(
+                    parse_agent_spec(self.agents[0])[0])
+            else:
+                coord = pick_coordinator_address()
+            pool: Optional[ActorPool] = None
+            try:
+                # inside try: a partially-constructed multi-machine pool
+                # (one agent down) must still tear down the workers it DID
+                # spawn
+                pool = ActorPool(num_processes,
+                                 env_per_worker=[dict(env or {})
+                                                 for _ in
+                                                 range(num_processes)],
+                                 agents=self.agents)
+                futures = pool.execute_per_worker(
+                    initialize_worker,
+                    [(coord, num_processes, i, platform,
+                      cpu_devices_per_process)
+                     for i in range(num_processes)])
+                for f in futures:
+                    f.result()
+                self.pool = pool
+                # a world left open at interpreter exit must die BEFORE
+                # multiprocessing's exit handler joins children:
+                # jax.distributed workers catch SIGTERM (preemption
+                # notifier), so the default terminate-and-join hangs.
+                # The closure holds the POOL strongly -- a world dropped
+                # without shutdown() (e.g. a GC'd trainer) still gets its
+                # worker processes killed at exit
+                import atexit
+
+                def _reap(p=pool):
+                    try:
+                        p.kill()
+                    except Exception:
+                        pass  # agents already gone; processes die with us
+
+                self._atexit_cb = _reap
+                atexit.register(_reap)
+                return
+            except RemoteError as e:
+                if pool is None:
+                    raise  # pool construction itself failed: no retry
+                pool.kill()
+                pool.shutdown()
+                bindy = any(tok in str(e).lower()
+                            for tok in ("bind", "address already in use"))
+                if not (bindy and attempt < 2):
+                    raise
+            except BaseException:
+                if pool is not None:
+                    pool.kill()
+                    pool.shutdown()
+                raise
+
+    def alive(self) -> bool:
+        return (self.pool is not None
+                and all(w.is_alive for w in self.pool.workers))
+
+    def run(self, trainable: Callable[[int], Any],
+            queue: Optional[TrampolineQueue] = None,
+            init_hook: Optional[Callable[[], None]] = None) -> List[Any]:
+        """Fan ``trainable(process_id)`` over the live world.  Returns
+        per-rank results, rank 0 first.  With a ``queue``, every worker
+        gets a session whose trampoline reaches this driver over TCP, so
+        tune callbacks work unchanged through remote workers."""
+        # liveness was checked by the caller (_acquire_world) moments ago;
+        # re-probing here would cost another N agent round-trips per entry
+        # point, and a racing death still surfaces as a dispatch failure
+        if self.pool is None:
+            raise RuntimeError("DistributedWorld is not alive (a prior run "
+                               "failed or it was shut down)")
+        qserver: Optional[QueueServer] = None
+        queue_address: Optional[str] = None
+        if queue is not None:
+            # loopback unless workers live on other machines; the query
+            # handler lets worker-side stop-polls/reports cross THIS fit
+            # and reach an enclosing tune driver (nested process trials)
+            qserver = QueueServer(queue,
+                                  bind="0.0.0.0" if self.agents else None,
+                                  query_handler=_nested_query_handler())
+            queue_address = qserver.address
+        try:
+            futures = self.pool.execute_per_worker(
+                _run_world_body,
+                [(i, trainable, queue_address, init_hook)
+                 for i in range(self.num_processes)])
+            return process_results(futures, queue)
+        except BaseException:
+            # a crashed rank leaves its peers blocked in the distributed
+            # barrier; they will never drain a shutdown sentinel -- kill
+            # the whole world (callers respawn a fresh one)
+            self.kill()
+            raise
+        finally:
+            if qserver is not None:
+                qserver.close()
+
+    def _drop_atexit(self) -> None:
+        cb = getattr(self, "_atexit_cb", None)
+        if cb is not None:
+            import atexit
+            atexit.unregister(cb)
+            self._atexit_cb = None
+
+    def kill(self) -> None:
+        self._drop_atexit()
+        if self.pool is not None:
+            self.pool.kill()
+            self.pool = None
+
+    def shutdown(self) -> None:
+        self._drop_atexit()
+        if self.pool is not None:
+            self.pool.shutdown()
+            self.pool = None
+
+    def __enter__(self) -> "DistributedWorld":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
 def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
                        platform: Optional[str] = None,
                        cpu_devices_per_process: Optional[int] = None,
@@ -68,79 +294,17 @@ def launch_distributed(trainable: Callable[[int], Any], num_processes: int,
                        agents: Optional[Sequence[str]] = None) -> List[Any]:
     """Fan `trainable(process_id)` over num_processes fresh processes, each
     with a jax.distributed world formed first.  Returns per-rank results,
-    rank 0 first.
+    rank 0 first.  One-shot wrapper over ``DistributedWorld`` (the
+    persistent form the Trainer uses across entry points).
 
     ``agents``: HostAgent addresses for a multi-machine launch (one worker
     process per address slot, contiguous blocks).  With a ``queue``, every
     worker gets a session whose trampoline reaches the driver over TCP, so
     tune callbacks work unchanged through remote workers.
-
-    The probe-then-close port pick in ``pick_coordinator_address`` has an
-    inherent reuse window (another process can claim the freed port before
-    rank 0's coordinator binds it); a bind failure is retried with a fresh
-    port rather than surfacing as an unattributable rendezvous hang.
     """
-    for attempt in range(3):
-        if agents:
-            from .agent import coordinator_address_on, parse_agent_spec
-            coord = coordinator_address_on(parse_agent_spec(agents[0])[0])
-        else:
-            coord = pick_coordinator_address()
-
-        qserver: Optional[QueueServer] = None
-        queue_address: Optional[str] = None
-        if queue is not None:
-            qserver = QueueServer(queue)
-            queue_address = qserver.address
-
-        def worker_body(process_id: int, coord=coord,
-                        queue_address=queue_address) -> Any:
-            initialize_worker(coord, num_processes, process_id, platform,
-                              cpu_devices_per_process)
-            client = None
-            if queue_address is not None:
-                from . import session as session_lib
-                from .queue import QueueClient
-                client = QueueClient(queue_address)
-                session_lib.init_session(process_id, client)
-            try:
-                if init_hook is not None:
-                    init_hook()
-                return trainable(process_id)
-            finally:
-                # the result travels the worker pipe while queued thunks
-                # travel a separate TCP connection: without this barrier the
-                # driver's final drain can run before the server enqueues
-                # the last thunks, dropping tune reports (mirrors
-                # _process_trial_main in tune/run.py)
-                if client is not None:
-                    client.flush()
-
-        pool: Optional[ActorPool] = None
-        try:
-            # inside try: a partially-constructed multi-machine pool (one
-            # agent down) must still tear down the workers it DID spawn
-            pool = ActorPool(num_processes,
-                             env_per_worker=[dict(env or {})
-                                             for _ in range(num_processes)],
-                             agents=agents)
-            futures = pool.execute_per_worker(
-                worker_body, [(i,) for i in range(num_processes)])
-            return process_results(futures, queue)
-        except RemoteError as e:
-            pool.kill()
-            bindy = any(tok in str(e).lower()
-                        for tok in ("bind", "address already in use"))
-            if not (bindy and attempt < 2):
-                raise
-        except BaseException:
-            # a crashed rank leaves its peers blocked in the distributed
-            # barrier; they will never drain a shutdown sentinel -- kill
-            if pool is not None:
-                pool.kill()
-            raise
-        finally:
-            if qserver is not None:
-                qserver.close()
-            if pool is not None:
-                pool.shutdown()
+    world = DistributedWorld(num_processes, platform,
+                             cpu_devices_per_process, env, agents)
+    try:
+        return world.run(trainable, queue=queue, init_hook=init_hook)
+    finally:
+        world.shutdown()

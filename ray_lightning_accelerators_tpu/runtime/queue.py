@@ -52,11 +52,16 @@ class QueueServer:
     ``(rank, thunk)`` frames; a reader thread per connection deserializes
     and enqueues locally."""
 
-    def __init__(self, queue: TrampolineQueue, bind: str = "0.0.0.0",
+    def __init__(self, queue: TrampolineQueue, bind: Optional[str] = None,
                  query_handler=None):
+        """``bind=None`` (default) binds loopback: queued thunks EXECUTE in
+        this process, so the port is only opened to the network when remote
+        workers actually need it (pass ``bind="0.0.0.0"`` for that, and set
+        ``RLA_TPU_AGENT_TOKEN`` -- an open wide bind is warned about)."""
         import socket as socket_mod
 
         from .agent import _node_ip, _token_from_env
+        from ..utils.logging import log
 
         self._queue = queue
         self._token = _token_from_env()  # fixed at construction
@@ -64,13 +69,23 @@ class QueueServer:
         # can ASK the driver something (e.g. "was my trial STOPped?") --
         # handler(name, payload) -> result, run on the reader thread
         self._query_handler = query_handler
+        loopback = bind is None or bind.startswith("127.")
+        if bind is None:
+            bind = "127.0.0.1"
+        if not loopback and self._token is None:
+            log.warning(
+                "QueueServer binding %s without RLA_TPU_AGENT_TOKEN: any "
+                "host that can reach this port can submit thunks that "
+                "execute driver-side; set the token on every machine",
+                bind)
         self._srv = socket_mod.socket(socket_mod.AF_INET,
                                       socket_mod.SOCK_STREAM)
         self._srv.setsockopt(socket_mod.SOL_SOCKET,
                              socket_mod.SO_REUSEADDR, 1)
         self._srv.bind((bind, 0))
         self._srv.listen(128)
-        self.address = f"{_node_ip()}:{self._srv.getsockname()[1]}"
+        host = "127.0.0.1" if loopback else _node_ip()
+        self.address = f"{host}:{self._srv.getsockname()[1]}"
         import threading
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
@@ -137,8 +152,13 @@ class QueueServer:
                 try:
                     result = (None if self._query_handler is None
                               else self._query_handler(name, payload))
-                except Exception:
-                    result = None  # a broken handler must not kill the pump
+                except Exception as e:
+                    # a broken handler must not kill the pump, but a silent
+                    # None coerces to "keep going" downstream -- say so
+                    from ..utils.logging import log
+                    log.warning("queue query handler failed for %r: %s",
+                                name, e)
+                    result = None
                 try:
                     send_msg(conn, ("__rla_query__", result))
                 except OSError:

@@ -23,6 +23,28 @@ from ..runtime import session as session_lib
 from ..utils.logging import log
 from . import run as run_lib
 
+def _actor_rank() -> int:
+    """This process's rank in the TRAINING world (0 when training is
+    single-process).  jax.process_index, not the session rank: a process
+    trial's session rank is its trial index, and the trial must still
+    report."""
+    import jax
+    return jax.process_index() if jax.process_count() > 1 else 0
+
+
+def _world_consistent(stop: bool) -> bool:
+    """Rank 0's stop verdict, made identical on every process of a
+    distributed fit (a tiny host broadcast; single-process worlds pass
+    through untouched)."""
+    import jax
+    if jax.process_count() <= 1:
+        return stop
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return bool(multihost_utils.broadcast_one_to_all(
+        np.asarray(stop, np.bool_)))
+
+
 _HOOK_MAP = {
     "validation_end": "on_validation_end",
     "train_epoch_end": "on_train_epoch_end",
@@ -98,13 +120,21 @@ class TuneReportCallback(TuneCallback):
         return report
 
     def _handle(self, trainer, module) -> None:
-        report = self._get_report_dict(trainer, module)
-        if report:
-            # thunk through the session queue (reference: tune.py:101)
-            session_lib.put_queue(lambda: run_lib.report(**report))
-        # cooperative scheduler stop: a STOP decision from a prior report
-        # ends training cleanly at this boundary
-        if run_lib.trial_should_stop():
+        # rank 0 reports (reference: tune.py:97-101 gates on
+        # get_actor_rank() == 0 -- inside a fanned-out fit every rank runs
+        # this callback on SPMD-identical metrics; one report per boundary)
+        if _actor_rank() == 0:
+            report = self._get_report_dict(trainer, module)
+            if report:
+                # run_lib.report routes itself: direct under a local trial
+                # session, synchronous query from a process trial -- the
+                # scheduler has decided before the next line runs
+                run_lib.report(**report)
+        # cooperative scheduler stop: rank 0's (now deterministic) view of
+        # the decision, broadcast so every process leaves the epoch loop
+        # together -- a per-rank poll could diverge and hang a collective
+        if _world_consistent(run_lib.trial_should_stop()
+                             if _actor_rank() == 0 else False):
             trainer.should_stop = True
 
 
@@ -122,10 +152,12 @@ class _TuneCheckpointCallback(TuneCallback):
         if trainer.sanity_checking:
             return
         payload = trainer.dump_checkpoint()  # host-side, mesh-materialized
-        step = trainer.global_step
-        filename = self._filename
-        session_lib.put_queue(
-            lambda: run_lib.checkpoint_payload(payload, step, filename))
+        if _actor_rank() != 0:
+            return  # dump is collective (mesh gather); write is rank-0's
+        # synchronous routing keeps checkpoint-before-report registration
+        # order (reference: tune.py:197-199)
+        run_lib.checkpoint_payload(payload, trainer.global_step,
+                                   self._filename)
 
 
 class TuneReportCheckpointCallback(TuneCallback):

@@ -145,6 +145,32 @@ def trial_should_stop() -> bool:
     return False
 
 
+def dispatch_trial_query(name: str, payload,
+                         lookup: Callable[[int], Optional[_TrialSession]]):
+    """Driver-side dispatch for the queue query channel, shared by the
+    tune driver's QueueServer and the fit-level nested forwarder
+    (runtime/bootstrap._nested_query_handler).  ``lookup(rank)`` resolves
+    the owning trial session.  Returns None for anything unresolvable --
+    callers treat None as "unhandled" and fall back to the thunk path."""
+    if name == "should_stop":
+        s = lookup(payload)
+        return bool(s is not None and s.trial.should_stop)
+    if name == "report":
+        rank, metrics = payload
+        s = lookup(rank)
+        if s is None:
+            return None
+        s.report(**metrics)
+        return bool(s.trial.should_stop)
+    if name == "checkpoint":
+        rank, pl, step, filename = payload
+        s = lookup(rank)
+        if s is None:
+            return None
+        return s.trial.create_checkpoint(pl, step, filename)
+    return None
+
+
 def trial_devices() -> Optional[list]:
     """The device partition assigned to the current trial, or None when
     trials own all devices (sequential mode).  Pass to an accelerator:
@@ -167,6 +193,19 @@ def report(**metrics) -> None:
     if _current_session() is None:
         from ..runtime import session as rt_session
         if rt_session.session_exists():
+            sess = rt_session.get_session()
+            q = getattr(sess, "_queue", None)
+            if hasattr(q, "query"):
+                # synchronous: the driver records the report AND runs the
+                # scheduler before this returns, so a following
+                # trial_should_stop() deterministically sees the decision
+                handled = q.query("report", (sess.rank, dict(metrics)))
+                if handled is not None:
+                    return
+                # None = no query handler up the chain could resolve the
+                # trial (e.g. concurrent thread trials, whose sessions are
+                # thread-bound and invisible to reader threads): the thunk
+                # path still works -- the drain runs with the session bound
             rt_session.put_queue(lambda: report(**metrics))
             return
     get_trial_session().report(**metrics)
@@ -174,6 +213,24 @@ def report(**metrics) -> None:
 
 def checkpoint_payload(payload: Dict[str, Any], step: int,
                        filename: str = "checkpoint") -> str:
+    """Write ``payload`` as the current trial's checkpoint.  Routed like
+    ``report``: direct with a local trial session, synchronous query from
+    a process trial (keeping the checkpoint-before-report registration
+    order the reference documents, reference: tune.py:197-199)."""
+    if _current_session() is None:
+        from ..runtime import session as rt_session
+        if rt_session.session_exists():
+            sess = rt_session.get_session()
+            q = getattr(sess, "_queue", None)
+            if hasattr(q, "query"):
+                path = q.query("checkpoint",
+                               (sess.rank, payload, step, filename))
+                if path is not None:
+                    return path
+            # unhandled up the chain: thunk fallback (see report())
+            rt_session.put_queue(
+                lambda: checkpoint_payload(payload, step, filename))
+            return ""
     return get_trial_session().trial.create_checkpoint(payload, step, filename)
 
 
@@ -288,8 +345,12 @@ def _process_trial_main(trainable, config, queue_address, trial_rank):
         return trainable(config)
     finally:
         # barrier: the trial's result races its last reports (different
-        # channels); flush guarantees the driver enqueued them first
-        client.flush()
+        # channels); flush guarantees the driver enqueued them first.  A
+        # dead driver must not mask the trainable's real exception.
+        try:
+            client.flush()
+        except (ConnectionError, OSError):
+            pass
 
 
 def _run_trials_in_processes(trainable, trials, scheduler,
@@ -318,14 +379,17 @@ def _run_trials_in_processes(trainable, trials, scheduler,
 
     def _query(name, payload):
         # worker-side trial_should_stop() polls land here (reader thread);
-        # reading the bool the drain thread sets is atomic under the GIL
-        if name == "should_stop":
-            s = sessions.get(payload)
-            return bool(s is not None and s.trial.should_stop)
-        return None
+        # reading the bool the drain thread sets is atomic under the GIL.
+        # report/checkpoint are synchronous: handled before the query
+        # returns, so the scheduler's decision for report k is visible to
+        # the trial's very next should_stop poll -- no drain-timing race
+        # (_TrialSession.report serializes itself and the scheduler)
+        return dispatch_trial_query(name, payload,
+                                    lambda rank: sessions.get(rank))
 
     q = TrampolineQueue()
-    server = QueueServer(q, query_handler=_query)
+    server = QueueServer(q, bind="0.0.0.0" if agents else None,
+                         query_handler=_query)
 
     def _spawn_worker(i: int):
         if agents:

@@ -97,16 +97,15 @@ def test_trainer_with_checkpoint_callback_in_process_trial(tmp_path):
 
 
 def _loopy_trial(config):
-    """Reports up to 12 times, polling the scheduler's stop decision
-    between reports (the decision lives driver-side; in a process trial
-    the poll crosses the network queue's query channel)."""
-    import time
-
+    """Reports up to 12 times, polling the scheduler's stop decision after
+    every report.  Event-driven: a process trial's report is a synchronous
+    query (the driver records it AND runs the scheduler before it
+    returns), so the immediately following poll deterministically sees the
+    decision for THAT report -- no sleeps, no drain-timing tolerance."""
     from ray_lightning_accelerators_tpu import tune as tune_mod
 
     for _ in range(12):
         tune_mod.report(loss=config["loss"])
-        time.sleep(0.15)  # let the driver drain + decide
         if tune_mod.trial_should_stop():
             return "stopped"
     return "completed"
@@ -115,7 +114,10 @@ def _loopy_trial(config):
 def test_scheduler_stop_ends_process_trial_early(tmp_path):
     """An ASHA STOP actually ends a process-isolated trial early (round-2
     weak #5: the decision was recorded but the trial burned its full
-    budget)."""
+    budget).  Sequential trials + synchronous reports make the stop point
+    exact: the bad trial reaches the rung-2 cutoff (good trial's 0.1
+    already recorded there), is STOPped on that report, and sees the
+    decision on its very next poll."""
     sched = tune.ASHAScheduler(metric="loss", mode="min",
                                grace_period=2, reduction_factor=2)
     analysis = tune.run(_loopy_trial,
@@ -128,7 +130,7 @@ def test_scheduler_stop_ends_process_trial_early(tmp_path):
     assert good.status == "TERMINATED"
     assert good.training_iteration == 12
     assert bad.status == "STOPPED"
-    assert bad.training_iteration < 8  # ended well short of its budget
+    assert bad.training_iteration == 2  # stopped AT the rung-2 decision
 
 
 def test_process_trials_over_agents(tmp_path):
