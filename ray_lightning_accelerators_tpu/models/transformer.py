@@ -18,6 +18,7 @@ exists to exercise and benchmark the framework's TPU path end-to-end:
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -527,6 +528,105 @@ class GPT(TpuModule):
             return (w["q8"].astype(jnp.float32) * w["scale"]).astype(dt)
         return w.astype(dt)
 
+    # -- int8 kernel dispatch (decode matmuls) ------------------------- #
+    # XLA's dequantize-then-dot on scanned weight stacks materializes the
+    # bf16 dequant in HBM, erasing the bandwidth win int8 storage exists
+    # for (measured: 1.03x).  The decode matmuls therefore route q8
+    # leaves through ops/quant.py Pallas kernels that stream int8 into
+    # VMEM and widen in-registers.  ``_force_q8_kernel``: None = auto
+    # (kernels on TPU), "interpret" = interpreter-mode kernels (CPU
+    # tests), False = always the XLA dequant fallback.
+    _force_q8_kernel = None
+
+    def _q8_kernel_mode(self):
+        forced = self._force_q8_kernel
+        if forced == "interpret":
+            return "interpret"
+        if forced is None and jax.default_backend() in ("tpu", "axon") \
+                and not os.environ.get("RLA_TPU_DISABLE_Q8_KERNEL"):
+            return "compiled"
+        return None
+
+    def _q8_mm(self, rows, q8_2d, scale_vec, dt):
+        """Shared kernel dispatch: ``rows [M,K] @ q8_2d [K,N]`` with
+        per-out-column ``scale_vec``, or (``scale_vec=None``)
+        ``rows [M,K] @ q8_2d[N,K]^T`` scale-free.  Returns None when the
+        kernel isn't engaged (wrong backend, unsupported shapes) -- the
+        caller falls back to the XLA dequant path."""
+        mode = self._q8_kernel_mode()
+        if mode is None:
+            return None
+        from ..ops import quant
+        interp = mode == "interpret"
+        if scale_vec is None:
+            n, k = q8_2d.shape
+            if not quant.supported(rows.shape[0], k, n):
+                return None
+            return quant.int8_matmul_nt(rows.astype(dt), q8_2d,
+                                        interpret=interp)
+        k, n = q8_2d.shape
+        if not quant.supported(rows.shape[0], k, n):
+            return None
+        return quant.int8_matmul(rows.astype(dt), q8_2d, scale_vec,
+                                 interpret=interp)
+
+    def _qkv_proj_decode(self, x, w, dt):
+        """[b,n,d] @ w[d,h,k] -> [b,h,n,k], q8-kernel aware."""
+        if self._is_q8(w):
+            q8 = w["q8"]
+            d, hh, kk = q8.shape
+            b, n, _ = x.shape
+            sv = jnp.broadcast_to(w["scale"], (1, hh, kk)).reshape(-1)
+            out = self._q8_mm(x.reshape(b * n, d),
+                              q8.reshape(d, hh * kk), sv, dt)
+            if out is not None:
+                return out.reshape(b, n, hh, kk).transpose(0, 2, 1, 3)
+        return jnp.einsum("bsd,dhk->bhsk", x, self._wt(w, dt))
+
+    def _attn_out_proj_decode(self, attn, w, dt):
+        """[b,h,n,k] @ w[h,k,d] -> [b,n,d], q8-kernel aware."""
+        if self._is_q8(w):
+            q8 = w["q8"]
+            hh, kk, d = q8.shape
+            b, _, n, _ = attn.shape
+            rows = attn.transpose(0, 2, 1, 3).reshape(b * n, hh * kk)
+            out = self._q8_mm(rows, q8.reshape(hh * kk, d),
+                              w["scale"].reshape(-1), dt)
+            if out is not None:
+                return out.reshape(b, n, d)
+        return jnp.einsum("bhsk,hkd->bsd", attn, self._wt(w, dt))
+
+    def _mlp_proj_decode(self, x, w, dt):
+        """[b,n,din] @ w[din,dout] -> [b,n,dout], q8-kernel aware."""
+        if self._is_q8(w):
+            q8 = w["q8"]
+            b, n, _ = x.shape
+            out = self._q8_mm(x.reshape(b * n, q8.shape[0]), q8,
+                              w["scale"].reshape(-1), dt)
+            if out is not None:
+                return out.reshape(b, n, q8.shape[1])
+        return jnp.einsum("bsd,df->bsf", x, self._wt(w, dt))
+
+    def _unembed_matmul(self, h2, params, dt):
+        """[M,d] @ unembed [d,V] -> [M,V] f32, q8-kernel aware.
+
+        Tied embeddings store q8 as [V,d] with scales along d (the
+        CONTRACTION dim), so the scales fold into the activation and the
+        transposed-weight kernel runs scale-free."""
+        if self.cfg.tie_embeddings and self._is_q8(params["embed"]):
+            sv = params["embed"]["scale"].reshape(-1)       # [d]
+            xs = h2.astype(jnp.float32) * sv
+            out = self._q8_mm(xs, params["embed"]["q8"], None, dt)
+            if out is not None:
+                return out.astype(jnp.float32)
+        if not self.cfg.tie_embeddings and self._is_q8(params.get("unembed")):
+            out = self._q8_mm(h2, params["unembed"]["q8"],
+                              params["unembed"]["scale"].reshape(-1), dt)
+            if out is not None:
+                return out.astype(jnp.float32)
+        return (h2.astype(dt) @ self._unembed_w(params, dt)
+                ).astype(jnp.float32)
+
     def _dequant_q8_leaves(self, tree, dt):
         """Dequantize ONLY int8 leaves in a subtree; dense leaves pass
         through untouched so downstream code keeps its own dtype policy
@@ -602,9 +702,9 @@ class GPT(TpuModule):
         n = h.shape[1]
         x = self._rms_norm(h, lp["ln1"])
         positions = pos0 + jnp.arange(n)
-        q = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wq"], dt))
-        k = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wk"], dt))
-        v = jnp.einsum("bsd,dhk->bhsk", x, self._wt(a["wv"], dt))
+        q = self._qkv_proj_decode(x, a["wq"], dt)
+        k = self._qkv_proj_decode(x, a["wk"], dt)
+        v = self._qkv_proj_decode(x, a["wv"], dt)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         W = ck.shape[2]
@@ -635,17 +735,17 @@ class GPT(TpuModule):
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bkgqt,bktd->bkgqd", p, cv.astype(jnp.float32))
         attn = attn.reshape(b, cfg.n_heads, n, cfg.head_dim).astype(dt)
-        h = h + jnp.einsum("bhsk,hkd->bsd", attn, self._wt(a["wo"], dt))
+        h = h + self._attn_out_proj_decode(attn, a["wo"], dt)
         x = self._rms_norm(h, lp["ln2"])
-        m = self._dequant_q8_leaves(lp["mlp"], dt)
         if cfg.num_experts > 1:
+            m = self._dequant_q8_leaves(lp["mlp"], dt)
             y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
                            capacity_factor=cfg.moe_capacity_factor,
                            compute_dtype=dt, mesh=self.mesh)
         else:
-            up = jax.nn.gelu(
-                jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
-            y = jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
+            m = lp["mlp"]
+            up = jax.nn.gelu(self._mlp_proj_decode(x, m["wi"], dt))
+            y = self._mlp_proj_decode(up, m["wo"], dt)
         return h + y, ck, cv
 
     def _decode_chunk(self, params, cache, tokens, pos0):
@@ -665,8 +765,9 @@ class GPT(TpuModule):
         h, (cks, cvs) = jax.lax.scan(
             layer, h, (params["layers"], cache["k"], cache["v"]))
         h = self._rms_norm(h, params["ln_f"])
-        logits = jnp.einsum("bsd,dv->bsv", h, self._unembed_w(params, dt)
-                            ).astype(jnp.float32)
+        b, n, d = h.shape
+        logits = self._unembed_matmul(h.reshape(b * n, d), params, dt
+                                      ).reshape(b, n, -1)
         return logits, {"k": cks, "v": cvs}
 
     def _decode_token(self, params, cache, token, pos):
@@ -685,8 +786,7 @@ class GPT(TpuModule):
         h, (cks, cvs) = jax.lax.scan(
             layer, h, (params["layers"], cache["k"], cache["v"]))
         h = self._rms_norm(h, params["ln_f"])
-        logits = (h[:, 0] @ self._unembed_w(params, dt)
-                  ).astype(jnp.float32)
+        logits = self._unembed_matmul(h[:, 0], params, dt)
         return logits, {"k": cks, "v": cvs}
 
     @staticmethod
@@ -741,7 +841,7 @@ class GPT(TpuModule):
             h_last, cache = self._prefill(params, prompt, cache_len)
             dt = self.compute_dtype
             logp0 = jax.nn.log_softmax(
-                (h_last @ self._unembed_w(params, dt)).astype(jnp.float32))
+                self._unembed_matmul(h_last, params, dt))
             # seed beams from the top-k first tokens (pad with -inf beams
             # when beam_size exceeds the vocab; they can never win)
             k0 = min(beam_size, logp0.shape[-1])
@@ -838,8 +938,7 @@ class GPT(TpuModule):
                 return jnp.where(seen, scaled, logits)
 
             logits0 = penalize(
-                (h_last @ self._unembed_w(params, dt)).astype(jnp.float32),
-                seen)
+                self._unembed_matmul(h_last, params, dt), seen)
             rng, r0 = jax.random.split(rng)
             tok0 = self._sample(logits0, temperature, top_k, top_p, r0)
             seen = seen | jax.nn.one_hot(tok0, self.cfg.vocab_size,

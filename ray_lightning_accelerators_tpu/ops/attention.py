@@ -339,10 +339,25 @@ def _use_pallas(q: jax.Array, block_q: Optional[int],
     return block_q is not None and block_k is not None and d >= 64
 
 
+def _default_blocks() -> tuple:
+    """Kernel block sizes: (block_q, block_k), overridable via
+    RLA_TPU_FLASH_BLOCK_Q/K for shape-specific tuning (read at trace
+    time, so set before the first jit of a given shape)."""
+    def read(var: str) -> int:
+        raw = os.environ.get(var, "")
+        try:
+            return int(raw) if raw else 512
+        except ValueError as e:
+            # fail HERE with the variable named, not deep inside a trace
+            raise ValueError(f"{var}={raw!r} is not an integer") from e
+    return read("RLA_TPU_FLASH_BLOCK_Q"), read("RLA_TPU_FLASH_BLOCK_K")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     window: Optional[int] = None) -> jax.Array:
     """Fused attention.  q,k,v: [batch, heads, seq, head_dim].
 
@@ -357,6 +372,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     b, h, q_len, d = q.shape
     scale_v = scale if scale is not None else d ** -0.5
+    dq, dk_ = _default_blocks()
+    block_q = dq if block_q is None else block_q
+    block_k = dk_ if block_k is None else block_k
     # effective blocks: the largest 128-aligned divisors of the extents,
     # so e.g. seq 640 tiles as 128-blocks instead of losing the kernel
     block_q = _pick_block(block_q, q_len)
@@ -375,6 +393,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, window):
     b, h, q_len, d = q.shape
     scale_v = scale if scale is not None else d ** -0.5
+    dq_, dk_ = _default_blocks()
+    block_q = dq_ if block_q is None else block_q
+    block_k = dk_ if block_k is None else block_k
     eff_q = _pick_block(block_q, q_len)
     eff_k = _pick_block(block_k, k.shape[2])
     if not _use_pallas(q, eff_q, eff_k):
@@ -393,6 +414,9 @@ def _fa_bwd(causal, scale, block_q, block_k, window, residuals, g):
     q, k, v, o3, lse = residuals
     b, h, q_len, d = q.shape
     scale_v = scale if scale is not None else d ** -0.5
+    dq_, dk_ = _default_blocks()
+    block_q = dq_ if block_q is None else block_q
+    block_k = dk_ if block_k is None else block_k
     if o3 is None:
         # reference forward path: grads of the reference formulation
         _, vjp = jax.vjp(
