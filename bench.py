@@ -164,12 +164,18 @@ def bench_gpt() -> dict:
     seq = 1024
     per_chip_batch = 16
     batch = per_chip_batch * n_devices
+    # tuned config (XPlane-traced, BASELINE.md roofline): 1024x1024 flash
+    # blocks amortize per-grid-cell overhead (fwd 18 -> 9.6 ms/step);
+    # 2048-row loss chunks pipeline the LM-head scan best (measured
+    # faster than 1024/4096/8192); 24 steps/epoch amortizes the one
+    # dispatch+sync each scanned epoch pays over the tunneled link
     cfg = TransformerConfig(vocab_size=50304, d_model=768, n_heads=12,
                             d_ff=3072, n_layers=12, max_seq_len=seq,
-                            fused_loss=True, loss_chunk_rows=4096)
+                            fused_loss=True, loss_chunk_rows=2048,
+                            flash_block_q=1024, flash_block_k=1024)
     model = GPT(cfg, lr=3e-4)
 
-    steps_per_epoch = 12
+    steps_per_epoch = 24
     n_seqs = batch * steps_per_epoch
     tokens = np.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size,

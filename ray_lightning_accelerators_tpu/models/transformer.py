@@ -81,6 +81,13 @@ class TransformerConfig:
     # combinable with a sharded sequence axis (ring/Ulysses are full-
     # attention strategies)
     sliding_window: Optional[int] = None
+    # flash-attention kernel block sizes; None = ops/attention.py default
+    # (512, env-overridable).  At seq 1024 on v5e-class chips 1024x1024
+    # measures fastest: per-grid-cell overhead beats the causal
+    # block-skipping that smaller blocks enable (XPlane-traced, see
+    # BASELINE.md roofline)
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -273,6 +280,8 @@ class GPT(TpuModule):
             return ring_attention_sharded(q, k, v, self.mesh,
                                           causal=self.cfg.causal)
         return flash_attention(q, k, v, self.cfg.causal,
+                               block_q=self.cfg.flash_block_q,
+                               block_k=self.cfg.flash_block_k,
                                window=self.cfg.sliding_window)
 
     def _dropout(self, x, rng):

@@ -31,21 +31,21 @@ fall back to the XLA dequant path at the call site.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import _pick_block
 
-def _pick(requested: int, length: int, unit: int) -> Optional[int]:
-    """Largest ``unit``-multiple block <= requested dividing ``length``."""
-    best = None
-    for cand in range(unit, min(requested, length) + 1, unit):
-        if length % cand == 0:
-            best = cand
-    return best
+# the kernels take the whole M dimension per grid cell: the f32
+# accumulator scratch [M, bn] + the [M, bk] input block must fit VMEM
+# (~16 MB/core) with room for double-buffered weight blocks.  Decode
+# rows are tiny (batch, or batch*chunk for speculative scoring); beyond
+# this bound the call site falls back to the XLA dequant path instead of
+# failing at Mosaic compile time.
+_MAX_M = 1024
 
 
 def _mm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr):
@@ -92,9 +92,10 @@ def _mm_nt_kernel(x_ref, w_ref, o_ref, acc_scr):
 
 def supported(m: int, k: int, n: int) -> bool:
     """Shapes the kernels tile cleanly (int8 sublane tiles are 32-row,
-    lanes 128-wide; see pallas_guide tiling table)."""
-    return (m >= 1 and _pick(512, k, 128) is not None
-            and _pick(512, n, 128) is not None and k % 32 == 0)
+    lanes 128-wide; see pallas_guide tiling table) within the VMEM
+    budget (_MAX_M rows)."""
+    return (1 <= m <= _MAX_M and _pick_block(512, k) is not None
+            and _pick_block(512, n) is not None and k % 32 == 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -107,8 +108,8 @@ def int8_matmul(x: jax.Array, wq: jax.Array, scale: jax.Array,
     m, k = x.shape
     k2, n = wq.shape
     assert k == k2 and scale.shape == (n,)
-    bk = _pick(512, k, 128)
-    bn = _pick(512, n, 128)
+    bk = _pick_block(512, k)
+    bn = _pick_block(512, n)
     s2 = scale.reshape(1, n).astype(jnp.float32)
     grid = (n // bn, k // bk)
     return pl.pallas_call(
@@ -136,8 +137,8 @@ def int8_matmul_nt(x: jax.Array, wq: jax.Array,
     m, k = x.shape
     n, k2 = wq.shape
     assert k == k2
-    bk = _pick(512, k, 128)
-    bn = _pick(512, n, 128)
+    bk = _pick_block(512, k)
+    bn = _pick_block(512, n)
     grid = (n // bn, k // bk)
     return pl.pallas_call(
         _mm_nt_kernel,

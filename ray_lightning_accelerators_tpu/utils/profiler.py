@@ -185,6 +185,108 @@ class Profiler:
             self.stop_trace()
 
 
+def trace_events(trace_dir: str) -> List[Dict[str, Any]]:
+    """Device-side op events from the newest ``*.trace.json.gz`` under an
+    XPlane trace directory (written by ``Profiler.start_trace``/
+    ``jax.profiler.start_trace``).
+
+    Each event: ``{name, ts_us, dur_us, end_us, category, bytes, flops}``
+    with durations from the DEVICE clock (``device_duration_ps``) -- on a
+    tunneled PjRt link these are the honest on-chip times while host
+    wall-clock mostly measures dispatch.  Host/python events are
+    excluded."""
+    import glob
+    import gzip
+    import json
+    import os
+
+    files = sorted(glob.glob(os.path.join(trace_dir, "**",
+                                          "*.trace.json.gz"),
+                             recursive=True), key=os.path.getmtime)
+    if not files:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(files[-1]) as f:
+        t = json.load(f)
+    out: List[Dict[str, Any]] = []
+    for e in t.get("traceEvents", []):
+        a = e.get("args") or {}
+        if e.get("ph") != "X" or "device_duration_ps" not in a:
+            continue
+        ts = float(a.get("device_offset_ps", 0)) / 1e6
+        dur = float(a["device_duration_ps"]) / 1e6
+        out.append({
+            "name": e["name"], "ts_us": ts, "dur_us": dur,
+            "end_us": ts + dur,
+            # timeline identity: events nest only WITHIN one device
+            # timeline; concurrent chips must not read as parent/child
+            "pid": e.get("pid"), "tid": e.get("tid"),
+            "category": a.get("hlo_category", "?"),
+            "bytes": int(a.get("raw_bytes_accessed",
+                               a.get("bytes_accessed", 0) or 0)),
+            "flops": int(a.get("model_flops", 0) or 0),
+        })
+    out.sort(key=lambda ev: (ev["ts_us"], -ev["dur_us"]))
+    return out
+
+
+def trace_op_summary(trace_dir: str, top: int = 0) -> Dict[str, Any]:
+    """Roofline-style aggregation of a device trace: EXCLUSIVE (self)
+    time per op and per HLO category, with achieved GB/s / TF/s.
+
+    Nested events (``while`` bodies, fusions inside scans) are resolved
+    by interval containment, so a scan's children are not double-counted
+    against their parent.  Returns ``{"total_ms", "by_category":
+    {cat: {self_ms, gbps, tfs, pct}}, "ops": [top-N rows]}``."""
+    evs = trace_events(trace_dir)
+    # stack-based nesting, one stack PER device timeline (pid, tid):
+    # concurrent chips overlap in time without any parent/child relation
+    stacks: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in evs:
+        stack = stacks.setdefault((e["pid"], e["tid"]), [])
+        while stack and stack[-1]["end_us"] <= e["ts_us"] + 1e-6:
+            stack.pop()
+        e["_child_dur"] = 0.0
+        if stack:
+            stack[-1]["_child_dur"] += e["dur_us"]
+        stack.append(e)
+    agg: Dict[Any, List[float]] = {}
+    for e in evs:
+        key = (e["category"], e["name"])
+        row = agg.setdefault(key, [0.0, 0, 0, 0])
+        row[0] += max(0.0, e["dur_us"] - e["_child_dur"])
+        row[1] += 1
+        row[2] += e["bytes"]
+        row[3] += e["flops"]
+    total_us = sum(v[0] for v in agg.values())
+
+    def rates(dur_us: float, nbytes: int, nflops: int) -> Dict[str, float]:
+        secs = dur_us * 1e-6
+        return {"gbps": nbytes / secs / 1e9 if secs else 0.0,
+                "tfs": nflops / secs / 1e12 if secs else 0.0}
+
+    cats: Dict[str, List[float]] = {}
+    for (cat, _name), (dur, _n, b, fl) in agg.items():
+        c = cats.setdefault(cat, [0.0, 0, 0])
+        c[0] += dur
+        c[1] += b
+        c[2] += fl
+    by_category = {
+        cat: {"self_ms": dur / 1e3,
+              "pct": 100.0 * dur / total_us if total_us else 0.0,
+              **rates(dur, b, fl)}
+        for cat, (dur, b, fl) in cats.items()}
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+    if top:
+        rows = rows[:top]
+    ops = [{"category": cat, "name": name, "self_ms": dur / 1e3,
+            "count": n,
+            "pct": 100.0 * dur / total_us if total_us else 0.0,
+            **rates(dur, b, fl)}
+           for (cat, name), (dur, n, b, fl) in rows]
+    return {"total_ms": total_us / 1e3, "by_category": by_category,
+            "ops": ops}
+
+
 def device_memory_stats() -> List[Dict[str, Any]]:
     """Per-device PjRt memory counters (bytes_in_use, peak, limit...).
 

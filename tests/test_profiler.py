@@ -128,3 +128,58 @@ def test_flops_estimate_and_mfu():
         assert fl == pytest.approx(2 * 128 * 256 * 64, rel=0.5)
     # explicit peak: 1 TFLOP/s peak, 1e9 flops in 1ms = 100% MFU
     assert mfu(1e9, 1e-3, peak_flops=1e12) == pytest.approx(1.0)
+
+
+def test_trace_op_summary_parses_device_events(tmp_path):
+    """trace_op_summary reads an XPlane-exported trace.json.gz, keeps only
+    device-clock events, resolves nesting (a scan's children don't
+    double-count against it), and reports achieved GB/s / TF/s."""
+    import gzip
+    import json
+
+    from ray_lightning_accelerators_tpu.utils.profiler import (
+        trace_events, trace_op_summary)
+
+    # synthetic trace: one while(0..1000us) containing two fusions
+    # (400us @ 1GB read, 500us of matmul flops), plus a host event that
+    # must be ignored (no device_duration_ps)
+    def dev(name, cat, off_us, dur_us, nbytes=0, flops=0):
+        return {"ph": "X", "name": name, "pid": 3, "ts": off_us,
+                "dur": dur_us,
+                "args": {"device_offset_ps": str(int(off_us * 1e6)),
+                         "device_duration_ps": str(int(dur_us * 1e6)),
+                         "hlo_category": cat,
+                         "raw_bytes_accessed": str(nbytes),
+                         "model_flops": str(flops)}}
+
+    trace = {"traceEvents": [
+        dev("while.1", "while", 0, 1000),
+        dev("fusion.1", "loop fusion", 10, 400, nbytes=10 ** 9),
+        dev("fusion.2", "convolution fusion", 450, 500,
+            flops=50 * 10 ** 12 * 500 // 10 ** 6),
+        {"ph": "X", "name": "host_thing", "pid": 701, "ts": 0, "dur": 5},
+        # a SECOND device timeline overlapping the first: concurrent
+        # chips must not read as parent/child of chip 0's while
+        {**dev("other_chip_op", "data formatting", 100, 300), "pid": 4},
+    ]}
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump(trace, f)
+
+    evs = trace_events(str(tmp_path))
+    assert [e["name"] for e in evs] == ["while.1", "fusion.1",
+                                       "other_chip_op", "fusion.2"]
+
+    s = trace_op_summary(str(tmp_path))
+    # chip 0's 1000us + chip 1's 300us, nothing double-counted
+    assert s["total_ms"] == pytest.approx(1.3, rel=1e-6)
+    by = s["by_category"]
+    # while self time = 1000 - 900 nested on ITS OWN timeline = 100us
+    # (the other chip's overlapping 300us op must not subtract)
+    assert by["while"]["self_ms"] == pytest.approx(0.1, rel=1e-6)
+    # 1 GB in 400us = 2500 GB/s
+    assert by["loop fusion"]["gbps"] == pytest.approx(2500.0, rel=1e-3)
+    assert by["convolution fusion"]["tfs"] == pytest.approx(50.0, rel=1e-3)
+    names = [o["name"] for o in s["ops"]]
+    assert "host_thing" not in names

@@ -59,6 +59,8 @@ def test_supported_shapes():
     assert quant.supported(16, 768, 50304)
     assert not quant.supported(16, 700, 768)   # k not 128-tileable
     assert not quant.supported(16, 768, 100)   # n not 128-tileable
+    # whole-M-per-cell kernels: huge row counts must fall back (VMEM)
+    assert not quant.supported(8192, 768, 768)
 
 
 def test_q8_decode_matches_dequant_decode():
