@@ -664,9 +664,13 @@ class Trainer:
         self._strip_for_shipment(module)
 
         queue = TrampolineQueue()
+        # datasets ship ONCE per world (content-addressed worker cache);
+        # a later test/predict/refit over the same data sends a key, not
+        # the bytes
         body = functools.partial(_remote_fit_worker, self, module,
-                                 train_dataloaders, val_dataloaders,
-                                 datamodule, ckpt_path)
+                                 world.ship_value(train_dataloaders),
+                                 world.ship_value(val_dataloaders),
+                                 world.ship_value(datamodule), ckpt_path)
         results = self._run_in_world(world, module, body, queue)
 
         # re-hydrate rank-0 state into the driver's trainer + module
@@ -710,7 +714,8 @@ class Trainer:
 
         queue = TrampolineQueue()
         body = functools.partial(_remote_eval_worker, self, module,
-                                 dataloaders, datamodule, stage)
+                                 world.ship_value(dataloaders),
+                                 world.ship_value(datamodule), stage)
         results = self._run_in_world(world, module, body, queue)
 
         module.trainer = self
@@ -1187,6 +1192,9 @@ def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
     global-batch metrics SPMD (every rank returns the same numbers);
     predict shards the loader with the strided eval sampler and returns
     this rank's outputs for driver-side re-interleaving."""
+    from ..runtime.bootstrap import resolve_shipped
+    dataloaders = resolve_shipped(dataloaders)
+    datamodule = resolve_shipped(datamodule)
     os.environ["RLA_TPU_INSIDE_WORKER"] = "1"
     if stage == "predict":
         if datamodule is not None:
@@ -1263,6 +1271,10 @@ def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,
     formed the jax.distributed world (the reference's ``train_remote``,
     ray_lightning/ray_ddp.py:199-220).  All ranks fit; rank 0 returns the
     materialized results the driver re-hydrates."""
+    from ..runtime.bootstrap import resolve_shipped
+    train_dataloaders = resolve_shipped(train_dataloaders)
+    val_dataloaders = resolve_shipped(val_dataloaders)
+    datamodule = resolve_shipped(datamodule)
     os.environ["RLA_TPU_INSIDE_WORKER"] = "1"
     trainer.fit(module, train_dataloaders, val_dataloaders,
                 datamodule=datamodule, ckpt_path=ckpt_path)

@@ -21,6 +21,7 @@ queue crosses the network through a `runtime.queue.QueueServer`.
 
 from __future__ import annotations
 
+import os
 import socket
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -93,6 +94,59 @@ def _nested_query_handler() -> Optional[Callable[[str, Any], Any]]:
     return handler
 
 
+# Ship-once store: content-keyed pickled blobs written to the worker
+# HOST's tmpdir (one copy per machine, shared by every worker process on
+# it), namespaced per world.  Resolution unpickles a FRESH object per use
+# -- runs mutate loaders (sampler injection etc.), so caching live
+# objects would leak one run's mutations into the next.
+
+
+def _ship_dir(ns: str) -> str:
+    import tempfile
+    return os.path.join(tempfile.gettempdir(), f"rla_ship_{ns}")
+
+
+class ShippedRef:
+    """Handle to a payload cached on every host of a DistributedWorld
+    (see ``DistributedWorld.ship_value``)."""
+
+    __slots__ = ("ns", "key")
+
+    def __init__(self, ns: str, key: str):
+        self.ns = ns
+        self.key = key
+
+
+def _store_shipped(ns: str, key: str, blob: bytes) -> None:
+    d = _ship_dir(ns)
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{key}.tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, os.path.join(d, key))  # atomic: readers see all or none
+
+
+def _cleanup_shipped(ns: str) -> None:
+    import shutil
+    shutil.rmtree(_ship_dir(ns), ignore_errors=True)
+
+
+def resolve_shipped(obj):
+    """Materialize a ShippedRef from this host's store (fresh copy);
+    pass anything else through."""
+    if isinstance(obj, ShippedRef):
+        import cloudpickle
+        path = os.path.join(_ship_dir(obj.ns), obj.key)
+        try:
+            with open(path, "rb") as f:
+                return cloudpickle.loads(f.read())
+        except FileNotFoundError:
+            raise KeyError(
+                f"shipped payload {obj.key[:12]} not cached on this host "
+                "(world respawned without re-shipping?)") from None
+    return obj
+
+
 def _run_world_body(process_id: int, trainable, queue_address, init_hook):
     """One entry-point run inside a (persistent) worker: fresh session
     bound to this run's queue, trainable, flush barrier."""
@@ -154,6 +208,13 @@ class DistributedWorld:
                      tuple(sorted((env or {}).items())),
                      tuple(self.agents or ()))
         self.pool: Optional[ActorPool] = None
+        # ship-once bookkeeping: content digests already cached on every
+        # HOST of this world (per-world tmpdir namespace), plus counters
+        # tests/users can read
+        import secrets
+        self._ship_ns = secrets.token_hex(8)
+        self._shipped: set = set()
+        self.ship_stats = {"sent": 0, "reused": 0}
         # the probe-then-close port pick has an inherent reuse window
         # (another process can claim the freed port before rank 0's
         # coordinator binds it); bind failures retry with a fresh port
@@ -220,6 +281,46 @@ class DistributedWorld:
         return (self.pool is not None
                 and all(w.is_alive for w in self.pool.workers))
 
+    def _one_worker_per_host(self) -> List[Any]:
+        """One representative worker per distinct placement: the store is
+        host-shared (tmpdir), so the blob crosses the wire once per
+        machine, not once per worker slot."""
+        seen = set()
+        reps = []
+        for w in self.pool.workers:
+            addr = getattr(w, "address", None)  # None = local subprocess
+            host = None if addr is None else addr.split(":")[0]
+            if host not in seen:
+                seen.add(host)
+                reps.append(w)
+        return reps
+
+    def ship_value(self, obj):
+        """Cache ``obj`` on every HOST of this world ONCE,
+        content-addressed; returns a ShippedRef later runs reference
+        instead of re-shipping the bytes (on real TPU hosts a dataset
+        crossing the wire per entry point is the dominant fit->test cost;
+        the reference ships its trainer to the object store once,
+        ray_ddp.py:169).  Workers unpickle a FRESH copy per resolve, so
+        one run's mutations never leak into the next.  ``None`` passes
+        through un-shipped."""
+        if obj is None:
+            return None
+        import hashlib
+
+        import cloudpickle
+        blob = cloudpickle.dumps(obj)
+        key = hashlib.sha256(blob).hexdigest()
+        if key in self._shipped:
+            self.ship_stats["reused"] += 1
+            return ShippedRef(self._ship_ns, key)
+        for f in [w.execute(_store_shipped, self._ship_ns, key, blob)
+                  for w in self._one_worker_per_host()]:
+            f.result()
+        self._shipped.add(key)
+        self.ship_stats["sent"] += 1
+        return ShippedRef(self._ship_ns, key)
+
     def run(self, trainable: Callable[[int], Any],
             queue: Optional[TrampolineQueue] = None,
             init_hook: Optional[Callable[[], None]] = None) -> List[Any]:
@@ -275,6 +376,16 @@ class DistributedWorld:
     def shutdown(self) -> None:
         self._drop_atexit()
         if self.pool is not None:
+            if self._shipped:
+                # best-effort: clear the per-world host caches while the
+                # workers are still alive (kill() paths leave the files
+                # to the OS tmp reaper)
+                try:
+                    for f in [w.execute(_cleanup_shipped, self._ship_ns)
+                              for w in self._one_worker_per_host()]:
+                        f.result(timeout=10)
+                except Exception:
+                    pass
             self.pool.shutdown()
             self.pool = None
 

@@ -131,11 +131,16 @@ def test_agent_auth_handshake(monkeypatch):
     addr = f"127.0.0.1:{agent.port}"
     try:
         # no token: the connection is dropped BEFORE the agent unpickles
-        # anything (unpickling an untrusted frame would itself be the RCE)
-        with pytest.raises(Exception, match="lost connection"):
+        # anything (unpickling an untrusted frame would itself be the
+        # RCE).  Depending on when the RST lands relative to the first
+        # op's send, the drop surfaces as "lost connection", "connection
+        # closed", or a plain socket error -- all are the refusal.
+        refusal = ("lost connection|connection closed|unreachable|"
+                   "Broken pipe|reset")
+        with pytest.raises(Exception, match=refusal):
             RemoteWorker(addr, rank=0)
         # wrong token: dropped the same way; surfaces on the first op
-        with pytest.raises(Exception, match="lost connection"):
+        with pytest.raises(Exception, match=refusal):
             AgentConnection(addr, token="wrong").call("ping", timeout=10)
         # right token (picked up from the env like `rla-tpu launch` does)
         monkeypatch.setenv(TOKEN_ENV, "s3cret")
@@ -561,6 +566,11 @@ def test_world_persists_across_entry_points(tmp_path):
         # one spawn per rank EVER, not per entry point
         assert sum(a.spawn_count for a in agents) == 2
         assert [a.spawn_count for a in agents] == [1, 1]
+        # the dataset shipped ONCE: later entry points over byte-identical
+        # loaders hit the worker-side content cache
+        stats = trainer._world.ship_stats
+        assert stats["sent"] >= 1
+        assert stats["reused"] >= 1, stats
 
         trainer.shutdown_workers()
         assert trainer._world is None

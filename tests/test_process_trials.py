@@ -171,3 +171,83 @@ def test_resources_per_trial_caps_concurrency(tmp_path):
                         resources_per_trial={"cpu": 10 ** 6},
                         trial_executor="process", trial_env=_ENV)
     assert all(t.status == "TERMINATED" for t in analysis.trials)
+
+
+def _nested_fit_trial(config):
+    """Trainable for a PROCESS trial that itself fans a 2-process
+    distributed fit out through host agents.  Reports ride the fit-level
+    queue's query channel and are FORWARDED to the tune driver one level
+    up (runtime/bootstrap._nested_query_handler); a scheduler STOP
+    reaches the fit workers the same way and ends training at the next
+    epoch boundary."""
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu import (DataLoader,
+                                                HorovodRayAccelerator,
+                                                Trainer, TuneReportCallback)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from tests.utils import BoringModel
+
+    class ScoredModel(BoringModel):
+        def __init__(self, score):
+            super().__init__()
+            self._score = float(score)
+
+        def training_step(self, params, batch, rng):
+            out = super().training_step(params, batch, rng)
+            loss, metrics = out if isinstance(out, tuple) else (out, {})
+            metrics = dict(metrics)
+            # a constant, config-controlled metric so the ASHA decision
+            # is deterministic
+            metrics["score"] = jnp.full((), self._score)
+            return loss, metrics
+
+    import jax.numpy as jnp
+
+    x = np.random.default_rng(0).normal(size=(32, 32)).astype("float32")
+    trainer = Trainer(
+        max_epochs=6, precision="f32", seed=0, enable_checkpointing=False,
+        callbacks=[TuneReportCallback({"score": "score"},
+                                      on="train_epoch_end")],
+        accelerator=HorovodRayAccelerator(num_hosts=2, num_slots=1,
+                                          agents=config["agents"]),
+        default_root_dir=f"/tmp/nested_trial_{os.getpid()}")
+    trainer.fit(ScoredModel(config["score"]),
+                DataLoader(ArrayDataset(x), batch_size=8))
+    return trainer.epochs_completed
+
+
+@pytest.mark.slow
+def test_scheduler_stop_reaches_fit_nested_in_process_trial(tmp_path):
+    """Round-3 advisor finding: a STOP decision must reach a distributed
+    fit nested inside a process trial (the fit-level QueueServer used to
+    answer None -> the trial burned its full budget).  Reports forward up
+    through the nested query handler, arrive exactly once per epoch
+    (rank-0 gated), and the STOP ends the bad trial's fit early."""
+    from ray_lightning_accelerators_tpu.runtime.agent import HostAgent
+
+    agents = [HostAgent(port=0, bind="127.0.0.1") for _ in range(2)]
+    for a in agents:
+        a.serve_in_background()
+    addrs = [f"127.0.0.1:{a.port}" for a in agents]
+    sched = tune.ASHAScheduler(metric="score", mode="min",
+                               grace_period=2, reduction_factor=2)
+    try:
+        analysis = tune.run(
+            _nested_fit_trial,
+            config={"score": tune.grid_search([0.1, 1.0]),
+                    "agents": addrs},
+            num_samples=1, metric="score", mode="min",
+            local_dir=str(tmp_path), scheduler=sched,
+            trial_executor="process", trial_env=_ENV)
+        by = {t.config["score"]: t for t in analysis.trials}
+        good, bad = by[0.1], by[1.0]
+        assert good.status == "TERMINATED"
+        assert good.training_iteration == 6   # one report per epoch
+        assert bad.status == "STOPPED"
+        # stopped AT the rung-2 decision: reported twice, fit ended at
+        # that epoch boundary
+        assert bad.training_iteration == 2
+    finally:
+        for a in agents:
+            a.shutdown()
