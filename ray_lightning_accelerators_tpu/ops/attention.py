@@ -272,6 +272,70 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                            dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                            scale, causal, block_q, block_k, window):
+    """Single-k-block fused backward: one pass computes dq for this q
+    block AND accumulates dk/dv across q blocks, sharing the sT/dpT
+    recompute the split kernels each redo (5 MXU matmuls per cell vs
+    3+4).  Engaged when the whole key length fits one block
+    (block_k == k_len), which the large-block configs hit."""
+    qi = pl.program_id(1)
+    last_q = pl.num_programs(1) - 1
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # with the full K extent in-block every causal/window q block has
+    # live keys, so there is no whole-block skip
+    pT, dsT = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                         qi, 0, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k, window=window)
+    dv_scr[:] += jax.lax.dot_general(
+        pT.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_scr[:] += jax.lax.dot_general(
+        dsT.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dq_ref[0] = jax.lax.dot_general(
+        dsT.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+    @pl.when(qi == last_q)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward_fused(q3, k3, v3, g3, lse, delta, scale, causal,
+                          block_q, block_k, interpret, window):
+    """One-kernel backward for k_len == block_k."""
+    bh, q_len, d = q3.shape
+    k_len = k3.shape[1]
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i: (b, 0, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
+        grid=(bh, q_len // block_q),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[qspec, kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, k_len, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, k_len, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            # the q walk carries the dk/dv accumulators
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+
+
 def _flash_backward(q3, k3, v3, o3, lse, g3, scale, causal, block_q,
                     block_k, interpret, window=None):
     """dq, dk, dv for folded [bh, seq, d] operands."""
@@ -280,6 +344,10 @@ def _flash_backward(q3, k3, v3, o3, lse, g3, scale, causal, block_q,
     # delta_i = rowsum(dO * O): tiny elementwise pass in XLA
     delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]                   # [bh, 1, q_len]
+    if block_k == k_len:
+        return _flash_backward_fused(q3, k3, v3, g3, lse, delta, scale,
+                                     causal, block_q, block_k, interpret,
+                                     window)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
