@@ -118,11 +118,14 @@ def test_sliding_window_gradients():
                                    **_GRAD_TOL)
 
 
+@pytest.mark.parametrize("block_k", [128, 256])
 @pytest.mark.parametrize("causal,window", [(False, None), (True, None),
                                            (True, 96)])
-def test_flash_backward_kernels_match(causal, window):
-    """The hand-written backward kernels (dq + dkv passes over transposed
-    score blocks) must reproduce XLA autodiff of the reference."""
+def test_flash_backward_kernels_match(causal, window, block_k):
+    """The hand-written backward kernels must reproduce XLA autodiff of
+    the reference: block_k=128 exercises the split dq + dkv passes,
+    block_k=256 (== k_len) the FUSED single-k-block kernel that shares
+    the score recompute."""
     from ray_lightning_accelerators_tpu.ops.attention import (
         flash_attention_grads_interpret)
 
@@ -135,33 +138,7 @@ def test_flash_backward_kernels_match(causal, window):
     _, vjp = jax.vjp(ref, q, k, v)
     want = vjp(g)
     got = flash_attention_grads_interpret(q, k, v, g, causal=causal,
-                                          block_q=128, block_k=128,
-                                          window=window)
-    for a, b in zip(got, want):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **_GRAD_TOL)
-
-
-@pytest.mark.parametrize("causal,window", [(False, None), (True, None),
-                                           (True, 96)])
-def test_flash_backward_fused_single_kblock_matches(causal, window):
-    """block_k == k_len engages the FUSED backward (one kernel computes
-    dq and accumulates dk/dv, sharing the score recompute); it must
-    reproduce XLA autodiff of the reference exactly like the split
-    kernels do."""
-    from ray_lightning_accelerators_tpu.ops.attention import (
-        flash_attention_grads_interpret)
-
-    q, k, v = _qkv(b=2, h=2, s=256, d=64)
-    g = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
-
-    def ref(q_, k_, v_):
-        return attention_reference(q_, k_, v_, causal=causal, window=window)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    want = vjp(g)
-    # block_k = full seq -> _flash_backward takes the fused path
-    got = flash_attention_grads_interpret(q, k, v, g, causal=causal,
-                                          block_q=128, block_k=256,
+                                          block_q=128, block_k=block_k,
                                           window=window)
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), **_GRAD_TOL)
