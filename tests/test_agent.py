@@ -572,8 +572,13 @@ def test_world_persists_across_entry_points(tmp_path):
         assert stats["sent"] >= 1
         assert stats["reused"] >= 1, stats
 
-        trainer.shutdown_workers()
+        # full teardown() ends the world too (the reference's teardown
+        # ends its actors, ray_ddp.py:109-121); a fresh entry point after
+        # it builds a new world rather than dispatching into a dead one
+        world = trainer._world
+        trainer.teardown()
         assert trainer._world is None
+        assert world.pool is None  # shut down, not leaked
     finally:
         for a in agents:
             a.shutdown()
