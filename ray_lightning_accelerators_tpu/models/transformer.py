@@ -253,13 +253,19 @@ class GPT(TpuModule):
         gather otherwise (no tensor sharding = no pathology, and gather
         is cheaper than the [*, V] one-hot)."""
         dt = self.compute_dtype
-        table = self._wt(params["embed"], dt)
+        w = params["embed"]
         t_size = (mesh_lib.mesh_axis_size(self.mesh, mesh_lib.TENSOR_AXIS)
                   if self.mesh is not None else 1)
         if t_size <= 1:
-            return table[tokens]
+            if self._is_q8(w):
+                # gather the int8 ROWS first, dequantize only those --
+                # dequantizing the whole [V, d] table per decode step
+                # would re-stream 3x its bytes for a handful of rows
+                rows = w["q8"][tokens].astype(jnp.float32)
+                return (rows * w["scale"].reshape(-1)).astype(dt)
+            return self._wt(w, dt)[tokens]
         onehot = jax.nn.one_hot(tokens, self.cfg.vocab_size, dtype=dt)
-        return jnp.einsum("...v,vd->...d", onehot, table)
+        return jnp.einsum("...v,vd->...d", onehot, self._wt(w, dt))
 
     def _rms_norm(self, x, scale):
         # fused pallas kernel on TPU, jnp reference elsewhere (ops/norms.py)
