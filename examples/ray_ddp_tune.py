@@ -44,19 +44,32 @@ def train_mnist(config, num_epochs=10, num_workers=1, smoke=False):
     trainer.fit(model, datamodule=dm)
 
 
+def _trial_main(cfg, num_epochs, num_workers, smoke):
+    train_mnist(cfg, num_epochs, num_workers, smoke)
+
+
 def tune_mnist(num_samples=10, num_epochs=10, num_workers=1, smoke=False,
-               parallel_trials=1, use_tpe=False):
+               parallel_trials=1, use_tpe=False, agents=None):
     config = {
         "layer_1": tune.choice([32, 64, 128]),
         "layer_2": tune.choice([64, 128, 256]),
         "lr": tune.loguniform(1e-4, 1e-1),
         "batch_size": tune.choice([32, 64, 128]),
     }
+    # --address places whole trials across cluster hosts (the reference's
+    # trials-anywhere placement, examples/ray_ddp_example.py:101-113):
+    # process-isolated trials round-robin over the agents, reporting
+    # through the network queue
+    import functools
+    trainable = functools.partial(_trial_main, num_epochs=num_epochs,
+                                  num_workers=num_workers, smoke=smoke)
     analysis = tune.run(
-        lambda cfg: train_mnist(cfg, num_epochs, num_workers, smoke),
+        trainable,
         config=config, num_samples=num_samples, metric="loss", mode="min",
         search_alg=tune.TPESearcher(seed=0) if use_tpe else None,
         max_concurrent_trials=parallel_trials,
+        trial_executor="process" if agents else "thread",
+        agents=agents,
         name="tune_mnist")
     print("Best hyperparameters found were:", analysis.best_config)
 
@@ -71,10 +84,15 @@ if __name__ == "__main__":
                              "device partitions")
     parser.add_argument("--tpe", action="store_true",
                         help="model-based TPE search instead of random")
+    parser.add_argument("--address", default=None,
+                        help="comma-separated host agents "
+                             "(host:port,...) to place PROCESS trials "
+                             "across machines")
     parser.add_argument("--smoke-test", action="store_true")
     args = parser.parse_args()
     if args.smoke_test:
         args.num_epochs, args.num_samples = 1, 1
     tune_mnist(args.num_samples, args.num_epochs, args.num_workers,
                args.smoke_test, parallel_trials=args.parallel_trials,
-               use_tpe=args.tpe)
+               use_tpe=args.tpe,
+               agents=args.address.split(",") if args.address else None)

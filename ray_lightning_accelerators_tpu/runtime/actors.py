@@ -47,14 +47,16 @@ _SENTINEL = b"__shutdown__"
 
 def _worker_main(conn, env: Dict[str, str]) -> None:
     os.environ.update(env)
-    if env.get("JAX_PLATFORMS"):
-        # a device plugin loaded from sitecustomize may have forced
-        # jax_platforms via CONFIG during interpreter startup (before this
-        # env update could matter); an explicit worker platform must win,
-        # or a CPU-pinned trial/worker hangs trying to claim the TPU
+    # a device plugin loaded from sitecustomize may have forced
+    # jax_platforms via CONFIG during interpreter startup; the
+    # environment's explicit choice must win (per-worker env first, then
+    # the env inherited from the spawning process), or a CPU-pinned
+    # trial/worker hangs trying to claim the TPU
+    platforms = env.get("JAX_PLATFORMS") or os.environ.get("JAX_PLATFORMS")
+    if platforms:
         try:
             import jax
-            jax.config.update("jax_platforms", env["JAX_PLATFORMS"])
+            jax.config.update("jax_platforms", platforms)
         except Exception:
             pass
     while True:
