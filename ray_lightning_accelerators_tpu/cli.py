@@ -41,6 +41,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     la.add_argument("script", help="driver python script")
     la.add_argument("script_args", nargs=argparse.REMAINDER)
 
+    tr = sub.add_parser(
+        "trace", help="summarize an XPlane device trace directory "
+                      "(written by Profiler.start_trace) as a per-op / "
+                      "per-category roofline table")
+    tr.add_argument("trace_dir", help="directory passed to start_trace")
+    tr.add_argument("--top", type=int, default=25,
+                    help="rows in the per-op table (0 = all)")
+
     args = parser.parse_args(argv)
     if args.cmd == "agent":
         import os
@@ -63,6 +71,21 @@ def main(argv: Optional[List[str]] = None) -> None:
         os.environ["RLA_TPU_AGENTS"] = args.agents
         sys.argv = [args.script] + list(args.script_args)
         runpy.run_path(args.script, run_name="__main__")
+    elif args.cmd == "trace":
+        from .utils.profiler import trace_op_summary
+
+        s = trace_op_summary(args.trace_dir, top=args.top)
+        print(f"device total: {s['total_ms']:.2f} ms\n")
+        print(f"{'category':<26} {'self ms':>10} {'GB/s':>8} "
+              f"{'TF/s':>7} {'%':>6}")
+        for cat, row in sorted(s["by_category"].items(),
+                               key=lambda kv: -kv[1]["self_ms"]):
+            print(f"{cat:<26} {row['self_ms']:>10.2f} {row['gbps']:>8.1f} "
+                  f"{row['tfs']:>7.1f} {row['pct']:>6.1f}")
+        print(f"\n{'op':<44} {'self ms':>10} {'n':>6} {'%':>6}")
+        for op in s["ops"]:
+            print(f"{op['name'][:44]:<44} {op['self_ms']:>10.2f} "
+                  f"{op['count']:>6d} {op['pct']:>6.1f}")
 
 
 if __name__ == "__main__":

@@ -238,11 +238,13 @@ def trace_op_summary(trace_dir: str, top: int = 0) -> Dict[str, Any]:
     against their parent.  Returns ``{"total_ms", "by_category":
     {cat: {self_ms, gbps, tfs, pct}}, "ops": [top-N rows]}``."""
     evs = trace_events(trace_dir)
-    # stack-based nesting, one stack PER device timeline (pid, tid):
-    # concurrent chips overlap in time without any parent/child relation
+    # stack-based nesting, one stack PER DEVICE (pid): concurrent chips
+    # overlap in time without any parent/child relation, but within one
+    # device the module/step wrapper events genuinely contain the op
+    # events even when exported on different trace lines (tids)
     stacks: Dict[Any, List[Dict[str, Any]]] = {}
     for e in evs:
-        stack = stacks.setdefault((e["pid"], e["tid"]), [])
+        stack = stacks.setdefault(e["pid"], [])
         while stack and stack[-1]["end_us"] <= e["ts_us"] + 1e-6:
             stack.pop()
         e["_child_dur"] = 0.0
