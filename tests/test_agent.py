@@ -668,3 +668,15 @@ def test_dead_world_respawns_on_next_entry_point(two_agents, tmp_path):
     assert metrics
     assert trainer._world is not world and trainer._world.alive()
     trainer.shutdown_workers()
+
+
+def test_queue_server_binds_loopback_by_default():
+    """Without remote agents in play the trampoline endpoint must not
+    open a network-reachable port (round-3 advisor finding: thunks
+    EXECUTE driver-side)."""
+    q = TrampolineQueue()
+    server = QueueServer(q)
+    try:
+        assert server.address.startswith("127.0.0.1:")
+    finally:
+        server.close()
