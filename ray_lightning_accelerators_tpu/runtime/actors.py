@@ -368,11 +368,14 @@ class ActorPool:
         return ranks
 
     def shutdown(self) -> None:
-        for w in self.workers:
+        # reverse rank order: rank 0 hosts the jax.distributed
+        # coordination service, and a peer outliving it by milliseconds
+        # logs a FATAL "leader died" before being reaped
+        for w in reversed(self.workers):
             w.shutdown()
 
     def kill(self) -> None:
-        for w in self.workers:
+        for w in reversed(self.workers):
             w.kill()
 
     def __enter__(self) -> "ActorPool":
