@@ -32,12 +32,66 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_MNIST_IMGS_PER_SEC = 25_000.0
 GPT_MFU_TARGET = 0.35
 BASELINE_CIFAR_IMGS_PER_SEC = 2_500.0  # single-A100 PTL+DDP ResNet18/CIFAR
+
+# Backend-death markers: one bench failing this way means every later
+# bench would re-attempt (and possibly hang) the same dead init.
+_BACKEND_DEAD_MARKERS = ("Unable to initialize backend",
+                         "failed to initialize backend",
+                         "No visible devices",
+                         "UNAVAILABLE")
+
+_PROBE_SRC = """
+import jax, numpy as np
+x = jax.numpy.ones((128, 128))
+v = float(np.asarray(jax.device_get((x @ x).sum())))
+print("PROBE_OK", v, [str(d) for d in jax.devices()], flush=True)
+"""
+
+
+def probe_backend(timeout_s: float) -> dict | None:
+    """Bounded-time liveness check of the JAX backend, in a subprocess.
+
+    A wedged device tunnel makes backend init hang indefinitely (the
+    round-4 driver run burned 25 minutes on exactly that before its
+    timeout killed the whole bench with zero output).  Touching the
+    device from a child process first means a hang costs ``timeout_s``
+    seconds, after which the parent -- which has not imported jax yet --
+    can still emit machine-readable output.  Returns None when the
+    backend is live, else an error record ready to print as JSON."""
+    t0 = time.perf_counter()
+    proc = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # SIGTERM first: a SIGKILLed process mid-device-claim can wedge
+        # the tunnel harder (the claim is never released); give the
+        # child a grace period to run its handlers before the hard kill
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return {"error": "backend unavailable",
+                "detail": f"device probe hung > {timeout_s:.0f}s "
+                          "(wedged tunnel?)",
+                "probe_seconds": round(time.perf_counter() - t0, 1)}
+    if proc.returncode != 0 or "PROBE_OK" not in out:
+        tail = (err or out).strip().splitlines()[-3:]
+        return {"error": "backend unavailable",
+                "detail": " | ".join(tail)[-500:],
+                "probe_seconds": round(time.perf_counter() - t0, 1)}
+    return None
 
 
 class _EpochClock:
@@ -339,20 +393,29 @@ def bench_decode() -> dict:
 
     dt_bf16 = timed(params)
     q8 = GPT.quantize_weights(params)
+    q8_config = "q8-kernel"
     try:
         dt_q8 = timed(q8)  # int8 Pallas kernels (ops/quant.py) on TPU
     except Exception as e:
         # kernel failed to compile on this backend: fall back to the XLA
-        # dequant path so the headline still lands
+        # dequant path so the headline still lands -- TAGGED in the
+        # record, so an int8_ratio near 1.0 is self-explaining
         print(f"bench decode int8 kernel failed ({type(e).__name__}: "
               f"{e}); falling back to dequant", file=sys.stderr,
               flush=True)
-        import os as os_mod
-        os_mod.environ["RLA_TPU_DISABLE_Q8_KERNEL"] = "1"
-        gen = jax.jit(functools.partial(model.generate,
-                                        max_new_tokens=new_tokens,
-                                        temperature=0.0))
-        dt_q8 = timed(q8)
+        q8_config = "fallback-dequant"
+        saved = os.environ.get("RLA_TPU_DISABLE_Q8_KERNEL")
+        os.environ["RLA_TPU_DISABLE_Q8_KERNEL"] = "1"
+        try:
+            gen = jax.jit(functools.partial(model.generate,
+                                            max_new_tokens=new_tokens,
+                                            temperature=0.0))
+            dt_q8 = timed(q8)
+        finally:  # scope the override to this timing, not the process
+            if saved is None:
+                os.environ.pop("RLA_TPU_DISABLE_Q8_KERNEL", None)
+            else:
+                os.environ["RLA_TPU_DISABLE_Q8_KERNEL"] = saved
     tps_bf16 = prompt.shape[0] * new_tokens / dt_bf16
     tps_q8 = prompt.shape[0] * new_tokens / dt_q8
 
@@ -397,6 +460,7 @@ def bench_decode() -> dict:
         "value": round(tps_bf16, 1),
         "unit": "tokens/sec/chip",
         "int8_ratio": round(tps_q8 / tps_bf16, 3),
+        "int8_config": q8_config,
         "batch": prompt.shape[0],
         "weight_stream_gbps_measured": round(stream_bps / 1e9, 1),
         "vs_baseline": round(tps_bf16 / roofline_tps, 3),
@@ -412,15 +476,43 @@ def main() -> None:
     parser.add_argument("--benches", default="mnist,gpt,cifar,decode",
                         help="comma-separated subset of "
                              f"{sorted(BENCHES)}")
+    parser.add_argument("--probe-timeout", type=float,
+                        default=float(os.environ.get(
+                            "RLA_TPU_PROBE_TIMEOUT", "120")),
+                        help="seconds before the pre-flight backend probe "
+                             "declares the backend dead (0 disables)")
     args = parser.parse_args()
+    if args.probe_timeout > 0:
+        err = probe_backend(args.probe_timeout)
+        if err is not None:
+            print(json.dumps({"metric": "backend_probe", "value": 0,
+                              "unit": "alive", "vs_baseline": 0.0, **err}),
+                  flush=True)
+            sys.exit(2)
     failed = False
     for name in [b.strip() for b in args.benches.split(",") if b.strip()]:
         try:
             print(json.dumps(BENCHES[name]()), flush=True)
         except Exception as e:  # emit remaining benches; Ctrl-C still aborts
             failed = True
-            print(f"bench {name} failed: {type(e).__name__}: {e}",
-                  file=sys.stderr, flush=True)
+            msg = f"{type(e).__name__}: {e}"
+            print(f"bench {name} failed: {msg}", file=sys.stderr,
+                  flush=True)
+            if any(m in str(e) for m in _BACKEND_DEAD_MARKERS):
+                # looks like the backend died mid-run -- but the marker
+                # set is broad (gRPC "UNAVAILABLE" can be a transient,
+                # bench-local error), so CONFIRM with a bounded re-probe
+                # before writing off the remaining benches
+                err = probe_backend(min(args.probe_timeout or 60, 60))
+                if err is not None:
+                    print(json.dumps(
+                        {"metric": "backend_probe", "value": 0,
+                         "unit": "alive", "vs_baseline": 0.0,
+                         "error": "backend died mid-run",
+                         "detail": msg[-500:], "failed_bench": name,
+                         **{"probe_" + k: v for k, v in err.items()}}),
+                        flush=True)
+                    sys.exit(2)
     if failed:
         sys.exit(1)
 

@@ -1151,9 +1151,45 @@ class Trainer:
         params = self._state.params
         outs = []
         for batch in dataloaders:
-            batch = self._put_batch(batch)
-            outs.append(jax.device_get(self._predict_step_fn(params, batch)))
+            batch, true_n, padded_n = self._wrap_pad_batch(batch)
+            out = jax.device_get(self._predict_step_fn(
+                params, self._put_batch(batch)))
+            if true_n is not None:
+                # slice ONLY leaves carrying the padded per-sample axis;
+                # a leaf with some other leading dim (per-head stats, a
+                # pooled scalar) holds no padding to strip
+                out = jax.tree.map(
+                    lambda x: x[:true_n] if np.ndim(x)
+                    and np.shape(x)[0] == padded_n else x, out)
+            outs.append(out)
         return outs
+
+    def _wrap_pad_batch(self, batch):
+        """Pad a final partial batch up to the mesh's dim-0 divisor.
+
+        The batch sharding scatters dim 0 over the data(+fsdp) axes, so a
+        last batch whose size doesn't divide the mesh cannot be
+        device_put at all -- predict() wrap-pads it (sample i mod n), and
+        the caller slices the padded rows back off the outputs.  Returns
+        ``(batch, true_n, padded_n)`` with ``true_n`` None when nothing
+        was done (divisible already, or no consistent per-sample axis)."""
+        sh = self._batch_sharding
+        spec0 = sh.spec[0] if sh.spec else None
+        if spec0 is None:
+            return batch, None, None
+        axes = spec0 if isinstance(spec0, tuple) else (spec0,)
+        div = int(np.prod([sh.mesh.shape[a] for a in axes]))
+        leaves = jax.tree.leaves(batch)
+        dims = {np.shape(x)[0] if np.ndim(x) else None for x in leaves}
+        if len(dims) != 1 or None in dims:
+            return batch, None, None
+        n = dims.pop()
+        if n % div == 0:
+            return batch, None, None
+        padded_n = n + (-n) % div
+        idx = np.arange(padded_n) % n
+        return (jax.tree.map(lambda a: np.asarray(a)[idx], batch), n,
+                padded_n)
 
     # ------------------------------------------------------------------ #
     def teardown(self) -> None:
@@ -1251,6 +1287,20 @@ def _interleave_predictions(per_rank: List[List[Any]],
         merged = [jax.tree.map(merge, *parts) for parts in zip(*per_rank)]
     if total is None:
         return merged
+    # wrap-padding truncation only makes sense when every leaf carries a
+    # per-sample leading axis; a per-batch scalar or pooled leaf would
+    # make the count wrong and silently drop REAL predictions (or keep
+    # padding) -- for those outputs, return the merged stream untouched
+    for batch in merged:
+        dims = {np.shape(leaf)[0] if np.ndim(leaf) else None
+                for leaf in jax.tree.leaves(batch)}
+        if None in dims or len(dims) != 1:
+            log.warning(
+                "predict outputs have no consistent per-sample leading "
+                "axis (leading dims %s within one batch); returning all "
+                "%d merged batches without wrap-padding truncation",
+                sorted(dims, key=str), len(merged))
+            return merged
     out: List[Any] = []
     seen = 0
     for batch in merged:

@@ -32,6 +32,7 @@ from ..parallel.ring_attention import ring_attention_sharded
 from ..ops.attention import flash_attention
 from ..ops.moe import init_moe_params, moe_logical_axes, moe_mlp
 from ..ops.norms import rms_norm
+from ..utils.logging import log
 
 
 @dataclasses.dataclass
@@ -496,9 +497,12 @@ class GPT(TpuModule):
     # ------------------------------------------------------------------ #
     # Decode is HBM-bandwidth-bound: every generated token re-reads every
     # weight.  Symmetric per-out-channel int8 halves the bytes per read vs
-    # bf16; dequant happens in-registers and XLA fuses it into the matmul.
-    # Quantized trees are for generate()/predict paths only (training
-    # keeps full precision).
+    # bf16 -- but only if HBM never sees a widened copy: the decode
+    # matmuls stream int8 through the Pallas kernels in ops/quant.py and
+    # widen in VMEM/registers.  (Letting XLA dequantize-then-dot instead
+    # materializes the bf16 dequant in HBM and erases the win: measured
+    # 1.03x, round 3.)  Quantized trees are for generate()/predict paths
+    # only (training keeps full precision).
 
     @staticmethod
     def quantize_weights(params):
@@ -557,6 +561,17 @@ class GPT(TpuModule):
         forced = self._force_q8_kernel
         if forced == "interpret":
             return "interpret"
+        if forced is None and self.mesh is not None and (
+                mesh_lib.mesh_axis_size(self.mesh,
+                                        mesh_lib.TENSOR_AXIS) > 1
+                or mesh_lib.mesh_axis_size(self.mesh,
+                                           mesh_lib.SEQUENCE_AXIS) > 1):
+            # pallas_call carries no GSPMD sharding rule: on a tensor- or
+            # sequence-sharded mesh the q8 weights would be all-gathered
+            # or fail to partition, erasing the bandwidth win the kernel
+            # exists for -- keep the shardable XLA dequant path instead
+            # (mirrors the _embed_lookup t_size gate above)
+            return None
         if forced is None and jax.default_backend() in ("tpu", "axon") \
                 and not os.environ.get("RLA_TPU_DISABLE_Q8_KERNEL"):
             return "compiled"
@@ -576,14 +591,31 @@ class GPT(TpuModule):
         if scale_vec is None:
             n, k = q8_2d.shape
             if not quant.supported(rows.shape[0], k, n):
+                self._q8_decline(rows.shape[0], k, n)
                 return None
             return quant.int8_matmul_nt(rows.astype(dt), q8_2d,
                                         interpret=interp)
         k, n = q8_2d.shape
         if not quant.supported(rows.shape[0], k, n):
+            self._q8_decline(rows.shape[0], k, n)
             return None
         return quant.int8_matmul(rows.astype(dt), q8_2d, scale_vec,
                                  interpret=interp)
+
+    _q8_declined_shapes: set = set()
+
+    @classmethod
+    def _q8_decline(cls, m, k, n):
+        """Warn once per shape when a q8 matmul falls back to XLA dequant
+        (measured ~1.03x, i.e. the int8 storage buys ~nothing there) --
+        a silently declined shape would look identical to a working
+        kernel in user-observed throughput."""
+        if (m, k, n) not in cls._q8_declined_shapes:
+            cls._q8_declined_shapes.add((m, k, n))
+            log.warning(
+                "int8 kernel declined shape M=%d K=%d N=%d (needs M<=1024"
+                " and block-divisible K/N); using XLA dequant fallback "
+                "for this matmul -- expect bf16-class bandwidth", m, k, n)
 
     def _qkv_proj_decode(self, x, w, dt):
         """[b,n,d] @ w[d,h,k] -> [b,h,n,k], q8-kernel aware."""

@@ -476,6 +476,22 @@ def parse_agent_spec(spec: str) -> Tuple[str, Optional[int]]:
     return addr.strip(), int(count) if star else None
 
 
+def queue_bind_for_agents(agents) -> Optional[str]:
+    """Bind address a driver-side QueueServer needs so these agents'
+    workers can reach it: ``None`` (loopback) when every agent is on
+    this host's loopback, else ``"0.0.0.0"``.  Keeping single-machine
+    agent setups on loopback means the tokenless-wide-bind refusal in
+    QueueServer only ever triggers for genuinely remote workers."""
+    if not agents:
+        return None
+    for spec in agents:
+        host = parse_agent_spec(spec)[0].rsplit(":", 1)[0]
+        if host not in ("127.0.0.1", "localhost") and \
+                not host.startswith("127."):
+            return "0.0.0.0"
+    return None
+
+
 def assign_agents(agents: Sequence[str], num_workers: int) -> List[str]:
     """Contiguous block assignment: worker i's agent.  Blocks keep each
     host's workers adjacent so global rank order groups by host (the

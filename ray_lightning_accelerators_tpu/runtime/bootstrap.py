@@ -340,8 +340,9 @@ class DistributedWorld:
             # loopback unless workers live on other machines; the query
             # handler lets worker-side stop-polls/reports cross THIS fit
             # and reach an enclosing tune driver (nested process trials)
+            from .agent import queue_bind_for_agents
             qserver = QueueServer(queue,
-                                  bind="0.0.0.0" if self.agents else None,
+                                  bind=queue_bind_for_agents(self.agents),
                                   query_handler=_nested_query_handler())
             queue_address = qserver.address
         try:

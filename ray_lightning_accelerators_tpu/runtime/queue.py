@@ -16,6 +16,7 @@ the reference handles at util.py:106-108) are preserved.
 
 from __future__ import annotations
 
+import os
 import queue as queue_mod
 import time
 from concurrent.futures import Future
@@ -73,11 +74,24 @@ class QueueServer:
         if bind is None:
             bind = "127.0.0.1"
         if not loopback and self._token is None:
+            # queued frames are unpickled and EXECUTED driver-side: an
+            # unauthenticated wide bind is remote code execution for any
+            # host that can reach the port.  Refuse unless explicitly
+            # opted out for a trusted/airgapped network.
+            if os.environ.get("RLA_TPU_ALLOW_TOKENLESS_BIND") != "1":
+                raise RuntimeError(
+                    f"QueueServer refuses to bind {bind} without "
+                    "RLA_TPU_AGENT_TOKEN: queued thunks execute "
+                    "driver-side, so an open wide bind lets any "
+                    "reachable host run code here.  Set the token on "
+                    "every machine (recommended), or set "
+                    "RLA_TPU_ALLOW_TOKENLESS_BIND=1 to accept the risk "
+                    "on a trusted network.")
             log.warning(
-                "QueueServer binding %s without RLA_TPU_AGENT_TOKEN: any "
-                "host that can reach this port can submit thunks that "
-                "execute driver-side; set the token on every machine",
-                bind)
+                "QueueServer binding %s without RLA_TPU_AGENT_TOKEN "
+                "(RLA_TPU_ALLOW_TOKENLESS_BIND=1): any host that can "
+                "reach this port can submit thunks that execute "
+                "driver-side", bind)
         self._srv = socket_mod.socket(socket_mod.AF_INET,
                                       socket_mod.SOCK_STREAM)
         self._srv.setsockopt(socket_mod.SOL_SOCKET,

@@ -387,8 +387,9 @@ def _run_trials_in_processes(trainable, trials, scheduler,
         return dispatch_trial_query(name, payload,
                                     lambda rank: sessions.get(rank))
 
+    from ..runtime.agent import queue_bind_for_agents
     q = TrampolineQueue()
-    server = QueueServer(q, bind="0.0.0.0" if agents else None,
+    server = QueueServer(q, bind=queue_bind_for_agents(agents),
                          query_handler=_query)
 
     def _spawn_worker(i: int):

@@ -680,3 +680,31 @@ def test_queue_server_binds_loopback_by_default():
         assert server.address.startswith("127.0.0.1:")
     finally:
         server.close()
+
+
+def test_queue_server_refuses_tokenless_wide_bind(monkeypatch):
+    """An unauthenticated 0.0.0.0 bind is an RCE surface (queued frames
+    are unpickled and executed driver-side): without RLA_TPU_AGENT_TOKEN
+    the server must refuse, not warn-and-proceed (round-4 advisor
+    finding) -- unless the explicit opt-out is set."""
+    monkeypatch.delenv("RLA_TPU_AGENT_TOKEN", raising=False)
+    monkeypatch.delenv("RLA_TPU_ALLOW_TOKENLESS_BIND", raising=False)
+    with pytest.raises(RuntimeError, match="RLA_TPU_AGENT_TOKEN"):
+        QueueServer(TrampolineQueue(), bind="0.0.0.0")
+    monkeypatch.setenv("RLA_TPU_ALLOW_TOKENLESS_BIND", "1")
+    server = QueueServer(TrampolineQueue(), bind="0.0.0.0")
+    server.close()
+
+
+def test_queue_bind_for_agents_stays_loopback_for_local_agents():
+    """Single-machine agent setups (every agent on 127.x) keep the
+    trampoline on loopback; any non-loopback agent needs the wide bind
+    (and then the tokenless refusal above applies)."""
+    from ray_lightning_accelerators_tpu.runtime.agent import \
+        queue_bind_for_agents
+    assert queue_bind_for_agents(None) is None
+    assert queue_bind_for_agents([]) is None
+    assert queue_bind_for_agents(["127.0.0.1:7777", "localhost:7778*2"]) \
+        is None
+    assert queue_bind_for_agents(["127.0.0.1:7777", "10.0.0.5:7777"]) \
+        == "0.0.0.0"

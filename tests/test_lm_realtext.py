@@ -1,0 +1,109 @@
+"""Real-text LM pipeline gate: loss must decrease on REAL English prose
+pushed through the full LM data path (BPE tokenizer -> blank-line doc
+split -> pack_sequences -> DataLoader -> Trainer.fit on GPT).
+
+The reference gates its training loop on real MNIST
+(reference: ray_lightning/tests/utils.py:137-152); this is the same
+bar for the LM path, on the committed corpus under tests/data/text/
+(real license prose -- see its README.md).  The synthetic grammar
+corpus cannot stand in here: its ~40-word vocabulary makes even a
+broken pipeline look learnable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_lightning_accelerators_tpu import (DataLoader, RayTPUAccelerator,
+                                            Trainer)
+from ray_lightning_accelerators_tpu.data.lm import (BPETokenizer,
+                                                    StreamingLMDataset,
+                                                    lm_dataset,
+                                                    pack_sequences)
+from ray_lightning_accelerators_tpu.models.transformer import (
+    GPT, TransformerConfig)
+
+_CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "text", "corpus.txt")
+
+
+def _read_corpus() -> str:
+    with open(_CORPUS, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_corpus_is_real_prose():
+    """The committed corpus is substantial English text, not a stub."""
+    text = _read_corpus()
+    assert len(text) > 100_000
+    words = text.split()
+    # real prose has a big vocabulary (the synthetic grammar has ~40)
+    assert len(set(w.lower() for w in words)) > 1500
+
+
+def test_loss_decreases_on_real_text():
+    text = _read_corpus()
+    tokenizer = BPETokenizer(text[:20_000], vocab_size=384)
+    docs = [tokenizer.encode(d) for d in text[:60_000].split("\n\n") if d]
+    packed = pack_sequences(docs, seq_len=128)
+    assert len(packed) >= 64  # enough real rows to train on
+
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    loader = DataLoader(ArrayDataset(packed), batch_size=16, shuffle=True)
+
+    cfg = TransformerConfig(vocab_size=tokenizer.vocab_size, d_model=128,
+                            n_heads=4, d_ff=512, n_layers=2,
+                            max_seq_len=128)
+    model = GPT(cfg, lr=3e-3)
+    trainer = Trainer(max_epochs=4, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0,
+                      default_root_dir="/tmp/rla_tpu_lm_realtext")
+
+    # untrained loss straight through the module's own validation_step
+    init_params = model.init_params(jax.random.PRNGKey(0))
+    out = model.validation_step(init_params, jax.numpy.asarray(packed[:16]))
+    before_loss = float(np.asarray(out["val_loss"]))
+
+    # untrained loss must sit near uniform -- ln(384) ~= 5.95 -- which
+    # also pins that vocab/packing wiring is sane (a tiny effective
+    # vocab from broken packing would start far below uniform)
+    assert before_loss > 0.8 * np.log(tokenizer.vocab_size)
+
+    trainer.fit(model, loader)
+    after = trainer.validate(model, loader)[0]
+    after_loss = float(after["val_loss"])
+
+    # real-text bar: the model must have learned real statistics --
+    # clearly below both its own starting point and the unigram-ish
+    # regime (a pipeline that shuffles targets or drops the shift
+    # cannot pass this)
+    assert after_loss < 0.7 * before_loss
+    assert after_loss < 4.0
+
+
+def test_streaming_packer_matches_batch_packer_on_real_text():
+    """StreamingLMDataset over the real corpus yields exactly the rows
+    pack_sequences produces (same doc split, same EOS policy)."""
+    text = _read_corpus()[:30_000]
+    tokenizer = BPETokenizer(text[:10_000], vocab_size=288)
+    docs = [tokenizer.encode(d) for d in text.split("\n\n") if d]
+    packed = pack_sequences(docs, seq_len=64)
+    ds = StreamingLMDataset(lambda epoch: iter(docs), seq_len=64)
+    streamed = np.stack(list(iter(ds)))
+    np.testing.assert_array_equal(packed, streamed)
+
+
+def test_lm_dataset_roundtrip_real_text():
+    """lm_dataset on real text: decode(encode(x)) round-trips through
+    the char tokenizer, and every packed id is in-vocab."""
+    text = _read_corpus()[:5_000]
+    ds, tok = lm_dataset(text, seq_len=64)
+    rows = np.stack([ds[i] for i in range(len(ds))])
+    assert rows.dtype == np.int32
+    assert rows.min() >= 0 and rows.max() < tok.vocab_size
+    sample = text.split("\n\n")[0]
+    assert tok.decode(tok.encode(sample)) == sample

@@ -64,10 +64,14 @@ def test_supported_shapes():
 
 
 def test_q8_decode_matches_dequant_decode():
-    """GPT.generate with quantized weights produces IDENTICAL tokens
-    whether the matmuls run through the int8 kernels (forced interpret
-    here) or the XLA dequant fallback -- the kernels are a pure
-    bandwidth optimization, not a numerics change."""
+    """The int8 kernels (forced interpret here) and the XLA dequant
+    fallback are the same computation up to f32 accumulation order: the
+    decode-step LOGITS must agree to tolerance, and the argmax must
+    agree wherever the top-1 margin exceeds that tolerance.  (Exact
+    token-sequence equality is deliberately NOT asserted -- a near-tie
+    logit can legitimately flip argmax between differently-ordered
+    reductions, and one flipped token diverges the rest of a greedy
+    decode.)"""
     from ray_lightning_accelerators_tpu.models.transformer import (
         GPT, TransformerConfig)
 
@@ -75,15 +79,38 @@ def test_q8_decode_matches_dequant_decode():
                             d_ff=256, n_layers=2, max_seq_len=64)
     model = GPT(cfg, lr=1e-3)
     params = model.init_params(jax.random.PRNGKey(0))
-    q8 = GPT.quantize_weights(params)
+    q8 = jax.tree.map(jnp.asarray, GPT.quantize_weights(params))
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, 512, (2, 8)), jnp.int32)
+    dt = model.compute_dtype
 
-    base = np.asarray(model.generate(q8, prompt, max_new_tokens=8))
+    def decode_logits():
+        """Prefill logits + one incremental decode-step logits, through
+        whatever q8 path _force_q8_kernel selects."""
+        h_last, cache = model._prefill(q8, prompt, cache_len=16)
+        l0 = model._unembed_matmul(h_last, q8, dt)
+        tok = jnp.argmax(l0, -1).astype(jnp.int32)
+        l1, _ = model._decode_token(q8, cache, tok, prompt.shape[1])
+        return np.asarray(l0, np.float32), np.asarray(l1, np.float32)
 
+    base0, base1 = decode_logits()
     model._force_q8_kernel = "interpret"  # route through the kernels
     try:
-        kern = np.asarray(model.generate(q8, prompt, max_new_tokens=8))
+        kern0, kern1 = decode_logits()
+        # the whole generate loop still runs through the kernels
+        toks = np.asarray(model.generate(q8, prompt, max_new_tokens=8))
     finally:
         model._force_q8_kernel = None
-    np.testing.assert_array_equal(base, kern)
+    assert toks.shape == (2, 16)
+
+    # measured: f32 paths agree to ~6e-7 while top-1 margins sit at
+    # 0.01-0.06 -- atol=1e-4 leaves two orders of headroom on both sides
+    atol = 1e-4
+    for base, kern in ((base0, kern0), (base1, kern1)):
+        np.testing.assert_allclose(kern, base, rtol=1e-3, atol=atol)
+        top2 = np.sort(base, axis=-1)[:, -2:]
+        margin = top2[:, 1] - top2[:, 0]
+        decisive = margin > 20 * atol
+        assert decisive.any()  # the check must actually bite
+        np.testing.assert_array_equal(
+            np.argmax(kern, -1)[decisive], np.argmax(base, -1)[decisive])
