@@ -69,10 +69,20 @@ class RayTPUAccelerator(Accelerator):
         self.agents = list(agents) if agents else None
 
     def launch_spec(self):
-        if self.num_hosts <= 1:
-            return None
         from ..runtime.agent import agents_from_env
-        agents = self.agents or agents_from_env()
+        if self.num_hosts <= 1:
+            # num_hosts == 1 with EXPLICIT agents still fans out: "run my
+            # training on that one (possibly remote, chip-holding) host"
+            # is the single-host analog of the reference placing its one
+            # actor wherever the resources are (ray_ddp.py:92-97).  Only
+            # the kwarg opts in -- an ambient $RLA_TPU_AGENTS left over
+            # from a multi-host run must not silently redirect (or break)
+            # default in-process training.
+            if not self.agents:
+                return None
+            agents = self.agents
+        else:
+            agents = self.agents or agents_from_env()
         if agents is None:
             log.warning(
                 "%s(num_hosts=%d) has no host agents configured (pass "

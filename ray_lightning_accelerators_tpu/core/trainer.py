@@ -579,6 +579,18 @@ class Trainer:
         jax.config."""
         env = {"RLA_TPU_INSIDE_WORKER": "1"}
         platform = cpu_per = None
+        worker_platform = os.environ.get("RLA_TPU_WORKER_PLATFORM")
+        if worker_platform:
+            # explicit split: workers claim this platform while the
+            # driver keeps its own backend -- the single-chip layout,
+            # where the DRIVER must stay off the TPU so the worker's
+            # device claim doesn't deadlock against the driver's
+            platform = worker_platform
+            env["JAX_PLATFORMS"] = worker_platform
+            if worker_platform == "cpu":
+                cpu_per = spec.get("devices_per_host") or 1
+                env["XLA_FLAGS"] = ""
+            return env, platform, cpu_per
         env_platform = os.environ.get("JAX_PLATFORMS",
                                       "").split(",")[0].lower()
         if env_platform == "cpu" or jax.default_backend() == "cpu":
@@ -1150,8 +1162,13 @@ class Trainer:
         dataloaders = self._ensure_eval_state(module, dataloaders, "predict")
         params = self._state.params
         outs = []
+        seen_n = None  # regular (already-compiled) batch size
         for batch in dataloaders:
-            batch, true_n, padded_n = self._wrap_pad_batch(batch)
+            batch, true_n, padded_n = self._wrap_pad_batch(batch, seen_n)
+            if true_n is None:
+                leaves = jax.tree.leaves(batch)
+                if leaves and np.ndim(leaves[0]):
+                    seen_n = np.shape(leaves[0])[0]
             out = jax.device_get(self._predict_step_fn(
                 params, self._put_batch(batch)))
             if true_n is not None:
@@ -1164,7 +1181,7 @@ class Trainer:
             outs.append(out)
         return outs
 
-    def _wrap_pad_batch(self, batch):
+    def _wrap_pad_batch(self, batch, target_n=None):
         """Pad a final partial batch up to the mesh's dim-0 divisor.
 
         The batch sharding scatters dim 0 over the data(+fsdp) axes, so a
@@ -1186,7 +1203,13 @@ class Trainer:
         n = dims.pop()
         if n % div == 0:
             return batch, None, None
+        # prefer padding up to ``target_n`` (the regular batch size the
+        # step function already compiled for) over the minimal multiple:
+        # a novel shape would force a whole extra XLA compile to save a
+        # few padded rows
         padded_n = n + (-n) % div
+        if target_n and target_n > n and target_n % div == 0:
+            padded_n = target_n
         idx = np.arange(padded_n) % n
         return (jax.tree.map(lambda a: np.asarray(a)[idx], batch), n,
                 padded_n)

@@ -23,3 +23,10 @@ _platform = os.environ.get("RLA_TPU_TEST_PLATFORM", "cpu")
 jax.config.update("jax_platforms", _platform)
 if _platform == "cpu":
     jax.config.update("jax_num_cpu_devices", 8)
+
+# RLA_TPU_WORKER_PLATFORM is scoped to the one test that gates on it
+# (test_tpu_world.py re-sets it from the stash inside the test): left
+# ambient, it would rewrite the platform of EVERY fan-out in the suite
+# -- with a real chip, two CPU-gloo tests' workers would contend for the
+# single device claim and deadlock.
+WORKER_PLATFORM_STASH = os.environ.pop("RLA_TPU_WORKER_PLATFORM", None)

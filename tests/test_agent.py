@@ -670,6 +670,52 @@ def test_dead_world_respawns_on_next_entry_point(two_agents, tmp_path):
     trainer.shutdown_workers()
 
 
+def test_single_host_agent_fans_out(tmp_path):
+    """num_hosts=1 WITH an agent configured still fans out -- "run my
+    training on that one (possibly remote, chip-holding) host" is the
+    single-host analog of the reference placing its one actor wherever
+    the resources are (reference: ray_ddp.py:92-97).  Previously
+    launch_spec() silently ignored explicit agents when num_hosts <= 1.
+    This is also the exact layout of the on-chip world gate
+    (test_tpu_world.py) with a CPU worker standing in for the chip."""
+    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
+                                                HorovodRayAccelerator,
+                                                Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from tests.utils import BoringModel
+
+    class PidCb(Callback):
+        def on_fit_end(self, trainer, module):
+            trainer.callback_metrics["worker_pid"] = float(os.getpid())
+
+    agent = HostAgent(port=0, bind="127.0.0.1")
+    agent.serve_in_background()
+    try:
+        x = np.random.default_rng(0).normal(size=(64, 32)).astype(
+            "float32")
+
+        def loader():
+            return DataLoader(ArrayDataset(x), batch_size=8,
+                              shuffle=False)
+
+        model = BoringModel()
+        trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                          enable_checkpointing=False, callbacks=[PidCb()],
+                          accelerator=HorovodRayAccelerator(
+                              num_hosts=1, num_slots=1,
+                              agents=[f"127.0.0.1:{agent.port}"]),
+                          default_root_dir=str(tmp_path))
+        trainer.fit(model, loader())
+        assert trainer.callback_metrics["worker_pid"] != float(os.getpid())
+        assert model.params is not None
+        preds = trainer.predict(model, loader())
+        assert sum(np.shape(p)[0] for p in preds) == len(x)
+        assert agent.spawn_count == 1  # one persistent worker, reused
+        trainer.teardown()
+    finally:
+        agent.shutdown()
+
+
 def test_queue_server_binds_loopback_by_default():
     """Without remote agents in play the trampoline endpoint must not
     open a network-reachable port (round-3 advisor finding: thunks
