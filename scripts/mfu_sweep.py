@@ -1,0 +1,141 @@
+"""MFU sweep harness for the GPT flagship bench config.
+
+Runs one bench-shaped GPT training measurement per requested variant and
+prints a JSON line each, so the BASELINE.md tuned ladder can be
+re-measured (and extended) on hardware in one command:
+
+    python scripts/mfu_sweep.py tuned remat-dots remat-dots-nbd b20
+
+Variants (all deltas are against the tuned r4 config: flash 1024x1024,
+loss_chunk 2048, 24-step epochs, per-chip batch 16, seq 1024):
+
+- ``r3``            the round-3 conservative config (512 blocks, chunk
+                    4096, 12-step epochs) -- the cross-round anchor
+- ``tuned``         the r4 tuned config exactly
+- ``remat-dots``    + per-layer jax.checkpoint, dots_saveable: keeps
+                    matmul outputs, recomputes elementwise (norm/rope/
+                    gelu) in the backward -- trades recompute VPU time
+                    for the residual-stacking HBM traffic the XPlane
+                    trace prices at ~30 ms/step (BASELINE.md)
+- ``remat-dots-nbd``+ dots_with_no_batch_dims_saveable (keeps only
+                    batch-free dots; more recompute, less traffic)
+- ``b20`` / ``b24`` per-chip batch 20 / 24 (b24 OOMed by 0.85 GB on the
+                    no-remat config; remat variants may fit -- a bigger
+                    batch amortizes fixed per-step costs)
+- ``chunk1024`` / ``chunk4096``  loss-chunk pipeline re-check
+
+Each variant is measured through the same public-API fit + epoch-clock
+discipline as bench.py (epoch 1 absorbs compile; scalar-readback sync).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+VARIANTS = {
+    # CPU-runnable plumbing check (tiny model; MFU meaningless)
+    "smoke": dict(loss_chunk=256, flash_block=128, steps_per_epoch=2,
+                  tiny=True),
+    "smoke-remat": dict(loss_chunk=256, flash_block=128,
+                        steps_per_epoch=2, tiny=True, remat=True,
+                        remat_policy="dots"),
+    "r3": dict(loss_chunk=4096, flash_block=512, steps_per_epoch=12),
+    "tuned": dict(loss_chunk=2048, flash_block=1024, steps_per_epoch=24),
+    "remat-dots": dict(loss_chunk=2048, flash_block=1024,
+                       steps_per_epoch=24, remat=True,
+                       remat_policy="dots"),
+    "remat-dots-nbd": dict(loss_chunk=2048, flash_block=1024,
+                           steps_per_epoch=24, remat=True,
+                           remat_policy="dots_with_no_batch_dims"),
+    "b20": dict(loss_chunk=2048, flash_block=1024, steps_per_epoch=24,
+                per_chip_batch=20),
+    "b24": dict(loss_chunk=2048, flash_block=1024, steps_per_epoch=24,
+                per_chip_batch=24),
+    "b20-remat-dots": dict(loss_chunk=2048, flash_block=1024,
+                           steps_per_epoch=24, per_chip_batch=20,
+                           remat=True, remat_policy="dots"),
+    "chunk1024": dict(loss_chunk=1024, flash_block=1024,
+                      steps_per_epoch=24),
+    "chunk4096": dict(loss_chunk=4096, flash_block=1024,
+                      steps_per_epoch=24),
+}
+
+
+def run_variant(name: str, spec: dict) -> dict:
+    import jax
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
+                                                RayTPUAccelerator, Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    from ray_lightning_accelerators_tpu.utils import profiler as prof
+    from bench import _EpochClock
+
+    n_devices = jax.device_count()
+    tiny = spec.get("tiny", False)
+    seq = 256 if tiny else 1024
+    per_chip_batch = spec.get("per_chip_batch", 2 if tiny else 16)
+    steps_per_epoch = spec["steps_per_epoch"]
+    batch = per_chip_batch * n_devices
+    cfg = TransformerConfig(vocab_size=512 if tiny else 50304,
+                            d_model=128 if tiny else 768,
+                            n_heads=4 if tiny else 12,
+                            d_ff=512 if tiny else 3072,
+                            n_layers=2 if tiny else 12, max_seq_len=seq,
+                            fused_loss=True,
+                            loss_chunk_rows=spec["loss_chunk"],
+                            flash_block_q=spec["flash_block"],
+                            flash_block_k=spec["flash_block"],
+                            remat=spec.get("remat", False),
+                            remat_policy=spec.get("remat_policy",
+                                                  "nothing"))
+    model = GPT(cfg, lr=3e-4)
+    tokens = np.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(batch * steps_per_epoch, seq)),
+        dtype=np.int32)
+    loader = DataLoader(ArrayDataset(tokens), batch_size=batch,
+                        shuffle=False)
+    clock = _EpochClock(Callback)
+    epochs = 3
+    trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
+                      precision="bf16", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0,
+                      callbacks=[clock.cb],
+                      default_root_dir="/tmp/rla_tpu_sweep")
+    trainer.fit(model, loader)
+    dt = clock.steady_state_seconds()
+    timed_steps = steps_per_epoch * (epochs - 1)
+    step_time = dt / timed_steps
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(model.params))
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    flops_per_step = flops_per_token * batch * seq
+    mfu = prof.mfu(flops_per_step / n_devices, step_time)
+    return {"variant": name, "step_ms": round(step_time * 1e3, 1),
+            "mfu": round(mfu, 4),
+            "tokens_per_sec_per_chip":
+                round(batch * seq / step_time / n_devices, 1),
+            "per_chip_batch": per_chip_batch, **{
+                k: v for k, v in spec.items() if k != "per_chip_batch"}}
+
+
+def main() -> None:
+    names = sys.argv[1:] or ["tuned", "remat-dots"]
+    for name in names:
+        try:
+            print(json.dumps(run_variant(name, VARIANTS[name])),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error":
+                              f"{type(e).__name__}: {e}"[:500]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
