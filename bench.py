@@ -226,7 +226,14 @@ def bench_gpt() -> dict:
 
 
 def _bench_gpt(loss_chunk: int, flash_block: int,
-               steps_per_epoch: int) -> dict:
+               steps_per_epoch: int, per_chip_batch: int = 16,
+               remat: bool = False, remat_policy: str = "nothing",
+               tiny: bool = False) -> dict:
+    """One bench-shaped GPT training measurement.  The extra knobs serve
+    scripts/mfu_sweep.py's variant ladder; keeping them HERE means every
+    sweep number is produced under exactly the timed-window/sync
+    discipline the driver's bench uses (``tiny`` shrinks the model for
+    CPU plumbing smokes -- its MFU is meaningless)."""
     import jax
     import numpy as np
 
@@ -238,14 +245,19 @@ def _bench_gpt(loss_chunk: int, flash_block: int,
     from ray_lightning_accelerators_tpu.utils import profiler as prof
 
     n_devices = jax.device_count()
-    seq = 1024
-    per_chip_batch = 16
+    seq = 256 if tiny else 1024
+    if tiny:
+        per_chip_batch = min(per_chip_batch, 2)
     batch = per_chip_batch * n_devices
-    cfg = TransformerConfig(vocab_size=50304, d_model=768, n_heads=12,
-                            d_ff=3072, n_layers=12, max_seq_len=seq,
+    cfg = TransformerConfig(vocab_size=512 if tiny else 50304,
+                            d_model=128 if tiny else 768,
+                            n_heads=4 if tiny else 12,
+                            d_ff=512 if tiny else 3072,
+                            n_layers=2 if tiny else 12, max_seq_len=seq,
                             fused_loss=True, loss_chunk_rows=loss_chunk,
                             flash_block_q=flash_block,
-                            flash_block_k=flash_block)
+                            flash_block_k=flash_block,
+                            remat=remat, remat_policy=remat_policy)
     model = GPT(cfg, lr=3e-4)
     n_seqs = batch * steps_per_epoch
     tokens = np.asarray(
@@ -284,6 +296,7 @@ def _bench_gpt(loss_chunk: int, flash_block: int,
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
         "mfu": round(mfu, 4),
+        "step_ms": round(step_time * 1e3, 1),
         "params": n_params,
         "seq_len": seq,
         "peak_flops_note": "per-chip bf16 peak from device_kind "

@@ -51,17 +51,9 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     args = parser.parse_args(argv)
     if args.cmd == "agent":
-        import os
-
-        from .runtime.agent import TOKEN_ENV, HostAgent
-        if args.bind not in ("127.0.0.1", "localhost") \
-                and not os.environ.get(TOKEN_ENV):
-            import warnings
-            warnings.warn(
-                f"agent binding {args.bind} without {TOKEN_ENV}: any host "
-                f"that can reach this port can execute code as this user; "
-                f"set {TOKEN_ENV} on agent and driver",
-                stacklevel=1)
+        from .runtime.agent import HostAgent
+        # a tokenless non-loopback bind raises inside HostAgent (RCE
+        # surface; RLA_TPU_ALLOW_TOKENLESS_BIND=1 is the explicit opt-out)
         HostAgent(args.port, args.bind).serve_forever()
     elif args.cmd == "launch":
         import os

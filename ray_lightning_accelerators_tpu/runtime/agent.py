@@ -134,6 +134,7 @@ class HostAgent:
         # other op; defaults to $RLA_TPU_AGENT_TOKEN so `rla-tpu agent` and
         # driver pick it up symmetrically.  None + loopback bind = open.
         self._token = token if token is not None else _token_from_env()
+        check_tokenless_wide_bind("HostAgent", bind, self._token)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind, port))
@@ -469,6 +470,32 @@ def agents_from_env() -> Optional[List[str]]:
     return [a.strip() for a in raw.split(",") if a.strip()] or None
 
 
+def is_loopback(host: str) -> bool:
+    return host in ("localhost",) or host.startswith("127.")
+
+
+def check_tokenless_wide_bind(what: str, bind: str,
+                              token: Optional[str]) -> None:
+    """Shared RCE gate for every endpoint that executes received thunks
+    (HostAgent runs them as this user; QueueServer unpickles and runs
+    them driver-side): a tokenless network-reachable bind is refused
+    unless RLA_TPU_ALLOW_TOKENLESS_BIND=1 explicitly accepts the risk --
+    and even then the exposure is logged on every start."""
+    if token is not None or is_loopback(bind):
+        return
+    if os.environ.get("RLA_TPU_ALLOW_TOKENLESS_BIND") != "1":
+        raise RuntimeError(
+            f"{what} refuses to bind {bind} without {TOKEN_ENV}: any "
+            "host that can reach this port can execute code as this "
+            "user.  Set the token on every machine (recommended), or "
+            "set RLA_TPU_ALLOW_TOKENLESS_BIND=1 to accept the risk on "
+            "a trusted network.")
+    log.warning(
+        "%s binding %s without %s (RLA_TPU_ALLOW_TOKENLESS_BIND=1): any "
+        "host that can reach this port can execute code as this user",
+        what, bind, TOKEN_ENV)
+
+
 def parse_agent_spec(spec: str) -> Tuple[str, Optional[int]]:
     """``"host:port*3"`` -> ``("host:port", 3)``; bare address -> count None
     (count decided by the balanced split)."""
@@ -485,9 +512,7 @@ def queue_bind_for_agents(agents) -> Optional[str]:
     if not agents:
         return None
     for spec in agents:
-        host = parse_agent_spec(spec)[0].rsplit(":", 1)[0]
-        if host not in ("127.0.0.1", "localhost") and \
-                not host.startswith("127."):
+        if not is_loopback(parse_agent_spec(spec)[0].rsplit(":", 1)[0]):
             return "0.0.0.0"
     return None
 

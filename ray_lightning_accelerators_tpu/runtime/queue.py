@@ -70,28 +70,14 @@ class QueueServer:
         # can ASK the driver something (e.g. "was my trial STOPped?") --
         # handler(name, payload) -> result, run on the reader thread
         self._query_handler = query_handler
-        loopback = bind is None or bind.startswith("127.")
+        from .agent import check_tokenless_wide_bind, is_loopback
+        loopback = bind is None or is_loopback(bind)
         if bind is None:
             bind = "127.0.0.1"
-        if not loopback and self._token is None:
-            # queued frames are unpickled and EXECUTED driver-side: an
-            # unauthenticated wide bind is remote code execution for any
-            # host that can reach the port.  Refuse unless explicitly
-            # opted out for a trusted/airgapped network.
-            if os.environ.get("RLA_TPU_ALLOW_TOKENLESS_BIND") != "1":
-                raise RuntimeError(
-                    f"QueueServer refuses to bind {bind} without "
-                    "RLA_TPU_AGENT_TOKEN: queued thunks execute "
-                    "driver-side, so an open wide bind lets any "
-                    "reachable host run code here.  Set the token on "
-                    "every machine (recommended), or set "
-                    "RLA_TPU_ALLOW_TOKENLESS_BIND=1 to accept the risk "
-                    "on a trusted network.")
-            log.warning(
-                "QueueServer binding %s without RLA_TPU_AGENT_TOKEN "
-                "(RLA_TPU_ALLOW_TOKENLESS_BIND=1): any host that can "
-                "reach this port can submit thunks that execute "
-                "driver-side", bind)
+        # queued frames are unpickled and EXECUTED driver-side -- the
+        # same RCE gate as HostAgent (refuse tokenless wide binds;
+        # RLA_TPU_ALLOW_TOKENLESS_BIND=1 opts out with a logged warning)
+        check_tokenless_wide_bind("QueueServer", bind, self._token)
         self._srv = socket_mod.socket(socket_mod.AF_INET,
                                       socket_mod.SOCK_STREAM)
         self._srv.setsockopt(socket_mod.SOL_SOCKET,

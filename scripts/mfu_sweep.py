@@ -65,64 +65,21 @@ VARIANTS = {
 
 
 def run_variant(name: str, spec: dict) -> dict:
-    import jax
-    import numpy as np
+    # the measurement itself lives in bench.py so every sweep number is
+    # produced under exactly the timed-window/sync discipline the
+    # driver's bench uses (bench-honesty: one shared implementation)
+    from bench import _bench_gpt
 
-    from ray_lightning_accelerators_tpu import (Callback, DataLoader,
-                                                RayTPUAccelerator, Trainer)
-    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
-    from ray_lightning_accelerators_tpu.models.transformer import (
-        GPT, TransformerConfig)
-    from ray_lightning_accelerators_tpu.utils import profiler as prof
-    from bench import _EpochClock
-
-    n_devices = jax.device_count()
-    tiny = spec.get("tiny", False)
-    seq = 256 if tiny else 1024
-    per_chip_batch = spec.get("per_chip_batch", 2 if tiny else 16)
-    steps_per_epoch = spec["steps_per_epoch"]
-    batch = per_chip_batch * n_devices
-    cfg = TransformerConfig(vocab_size=512 if tiny else 50304,
-                            d_model=128 if tiny else 768,
-                            n_heads=4 if tiny else 12,
-                            d_ff=512 if tiny else 3072,
-                            n_layers=2 if tiny else 12, max_seq_len=seq,
-                            fused_loss=True,
-                            loss_chunk_rows=spec["loss_chunk"],
-                            flash_block_q=spec["flash_block"],
-                            flash_block_k=spec["flash_block"],
-                            remat=spec.get("remat", False),
-                            remat_policy=spec.get("remat_policy",
-                                                  "nothing"))
-    model = GPT(cfg, lr=3e-4)
-    tokens = np.asarray(
-        np.random.default_rng(0).integers(
-            0, cfg.vocab_size, size=(batch * steps_per_epoch, seq)),
-        dtype=np.int32)
-    loader = DataLoader(ArrayDataset(tokens), batch_size=batch,
-                        shuffle=False)
-    clock = _EpochClock(Callback)
-    epochs = 3
-    trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
-                      precision="bf16", enable_checkpointing=False,
-                      log_every_n_steps=10 ** 9, seed=0,
-                      callbacks=[clock.cb],
-                      default_root_dir="/tmp/rla_tpu_sweep")
-    trainer.fit(model, loader)
-    dt = clock.steady_state_seconds()
-    timed_steps = steps_per_epoch * (epochs - 1)
-    step_time = dt / timed_steps
-    n_params = sum(int(np.prod(p.shape))
-                   for p in jax.tree.leaves(model.params))
-    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
-    flops_per_step = flops_per_token * batch * seq
-    mfu = prof.mfu(flops_per_step / n_devices, step_time)
-    return {"variant": name, "step_ms": round(step_time * 1e3, 1),
-            "mfu": round(mfu, 4),
-            "tokens_per_sec_per_chip":
-                round(batch * seq / step_time / n_devices, 1),
-            "per_chip_batch": per_chip_batch, **{
-                k: v for k, v in spec.items() if k != "per_chip_batch"}}
+    rec = _bench_gpt(loss_chunk=spec["loss_chunk"],
+                     flash_block=spec["flash_block"],
+                     steps_per_epoch=spec["steps_per_epoch"],
+                     per_chip_batch=spec.get("per_chip_batch", 16),
+                     remat=spec.get("remat", False),
+                     remat_policy=spec.get("remat_policy", "nothing"),
+                     tiny=spec.get("tiny", False))
+    return {"variant": name, "step_ms": rec["step_ms"],
+            "mfu": rec["mfu"],
+            "tokens_per_sec_per_chip": rec["value"], **spec}
 
 
 def main() -> None:

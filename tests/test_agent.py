@@ -728,6 +728,22 @@ def test_queue_server_binds_loopback_by_default():
         server.close()
 
 
+def test_host_agent_refuses_tokenless_wide_bind(monkeypatch):
+    """Agents execute arbitrary thunks as this user -- the QueueServer's
+    tokenless-wide-bind refusal applies to them identically."""
+    monkeypatch.delenv("RLA_TPU_AGENT_TOKEN", raising=False)
+    monkeypatch.delenv("RLA_TPU_ALLOW_TOKENLESS_BIND", raising=False)
+    with pytest.raises(RuntimeError, match="RLA_TPU_AGENT_TOKEN"):
+        HostAgent(port=0, bind="0.0.0.0")
+    # a token makes the wide bind legitimate
+    agent = HostAgent(port=0, bind="0.0.0.0", token="s3cret")
+    agent.shutdown()
+    # ... as does the explicit opt-out
+    monkeypatch.setenv("RLA_TPU_ALLOW_TOKENLESS_BIND", "1")
+    agent = HostAgent(port=0, bind="0.0.0.0")
+    agent.shutdown()
+
+
 def test_queue_server_refuses_tokenless_wide_bind(monkeypatch):
     """An unauthenticated 0.0.0.0 bind is an RCE surface (queued frames
     are unpickled and executed driver-side): without RLA_TPU_AGENT_TOKEN
