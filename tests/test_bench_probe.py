@@ -1,0 +1,120 @@
+"""bench.py wedge-proofing: the pre-flight backend probe must turn a
+dead/hung backend into ONE machine-readable record in bounded time (the
+round-4 driver bench burned its whole window hanging on a wedged device
+tunnel and produced zero output; these tests pin the machinery that
+prevents a repeat).  The probe child is faked by monkeypatching the
+probe source -- the logic under test is the parent's subprocess
+handling, not JAX."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+
+def test_probe_passes_on_healthy_child(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "print('PROBE_OK 1.0 fake-devices')")
+    assert bench.probe_backend(timeout_s=30) is None
+
+
+def test_probe_reports_failing_child(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC",
+        "import sys; sys.stderr.write('Unable to initialize backend "
+        "axon: UNAVAILABLE');\nraise SystemExit(1)")
+    err = bench.probe_backend(timeout_s=30)
+    assert err is not None
+    assert err["error"] == "backend unavailable"
+    assert "Unable to initialize" in err["detail"]
+    assert err["probe_seconds"] < 30
+
+
+def test_probe_kills_hung_child_within_timeout(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "import time; time.sleep(600)")
+    err = bench.probe_backend(timeout_s=2)
+    assert err is not None
+    assert "hung" in err["detail"]
+    # bounded: the whole point is not burning the driver's window
+    assert err["probe_seconds"] < 30
+
+
+def test_probe_rejects_child_without_marker(monkeypatch):
+    # a child that exits 0 but never ran the device op must NOT pass
+    monkeypatch.setattr(bench, "_PROBE_SRC", "print('something else')")
+    assert bench.probe_backend(timeout_s=30) is not None
+
+
+def test_dead_backend_emits_one_json_line_and_exit_2(monkeypatch,
+                                                     capsys):
+    """main() with a dead backend: exactly one parseable record,
+    exit code 2, and no bench ever ran."""
+    monkeypatch.setattr(bench, "_PROBE_SRC", "raise SystemExit(1)")
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "mnist",
+                         "--probe-timeout", "5"])
+    ran = []
+    monkeypatch.setitem(bench.BENCHES, "mnist",
+                        lambda: ran.append(1) or {})
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    assert not ran
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "backend_probe"
+    assert rec["error"] == "backend unavailable"
+
+
+def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
+                                                       capsys):
+    """A bench raising a CERTAIN backend-death marker aborts the rest
+    with a machine-readable record (no probe needed)."""
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "a,b",
+                         "--probe-timeout", "0"])
+
+    def dead():
+        raise RuntimeError("Unable to initialize backend 'axon'")
+
+    ran = []
+    monkeypatch.setitem(bench.BENCHES, "a", dead)
+    monkeypatch.setitem(bench.BENCHES, "b", lambda: ran.append(1) or {})
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    assert not ran  # b never ran against the dead backend
+    rec = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert rec["error"] == "backend died mid-run"
+    assert rec["failed_bench"] == "a"
+
+
+def test_suspect_marker_with_probe_disabled_continues(monkeypatch,
+                                                      capsys):
+    """A transient-looking gRPC 'UNAVAILABLE' with probing disabled
+    must NOT kill the remaining benches."""
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "a,b",
+                         "--probe-timeout", "0"])
+
+    def flaky():
+        raise RuntimeError("DEADLINE_EXCEEDED then UNAVAILABLE retry")
+
+    ran = []
+    monkeypatch.setitem(bench.BENCHES, "a", flaky)
+    monkeypatch.setitem(
+        bench.BENCHES, "b",
+        lambda: ran.append(1) or {"metric": "b", "value": 1,
+                                  "unit": "x", "vs_baseline": 1})
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1  # a failed, but b still ran
+    assert ran
+    out = capsys.readouterr().out
+    assert '"metric": "b"' in out

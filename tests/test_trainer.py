@@ -196,3 +196,49 @@ def test_max_time_stops(tmpdir):
     assert _time.perf_counter() - t0 < 30
     assert trainer.should_stop
     assert trainer.global_step >= 1
+
+
+def test_wrap_pad_batch_contract():
+    """predict()'s final-partial-batch padding: pads dim 0 to the mesh
+    divisor (reusing an already-compiled size when offered), refuses
+    trees without one consistent per-sample axis."""
+    import jax
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+
+    trainer = Trainer(precision="f32", enable_checkpointing=False)
+    mesh = mesh_lib.build_mesh()  # 8 virtual devices, data axis
+    trainer._batch_sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(("data", "fsdp")))
+
+    # divisible: untouched
+    b = {"x": np.ones((16, 3)), "y": np.arange(16)}
+    out, true_n, padded_n = trainer._wrap_pad_batch(b)
+    assert true_n is None and out is b
+
+    # partial: wrap-padded to the minimal multiple of 8
+    b = {"x": np.arange(10)[:, None] * np.ones((10, 3)),
+         "y": np.arange(10)}
+    out, true_n, padded_n = trainer._wrap_pad_batch(b)
+    assert (true_n, padded_n) == (10, 16)
+    np.testing.assert_array_equal(out["y"],
+                                  np.arange(16) % 10)
+
+    # partial with a known compiled size: pads up to THAT (no novel
+    # shape -> no extra XLA compile), not the minimal multiple
+    out, true_n, padded_n = trainer._wrap_pad_batch(b, 32)
+    assert (true_n, padded_n) == (10, 32)
+    assert out["x"].shape == (32, 3)
+
+    # a target that isn't divisor-aligned falls back to minimal
+    out, true_n, padded_n = trainer._wrap_pad_batch(b, 30)
+    assert (true_n, padded_n) == (10, 16)
+
+    # no consistent per-sample axis: refuse (predict returns unsliced)
+    mixed = {"x": np.ones((10, 3)), "stats": np.ones((4,))}
+    out, true_n, padded_n = trainer._wrap_pad_batch(mixed)
+    assert true_n is None and out is mixed
+    scalar = {"x": np.ones((10, 3)), "n": np.float32(3.0)}
+    out, true_n, padded_n = trainer._wrap_pad_batch(scalar)
+    assert true_n is None and out is scalar
