@@ -58,6 +58,28 @@ print("PROBE_OK", v, [str(d) for d in jax.devices()], flush=True)
 """
 
 
+def _terminate(proc) -> str:
+    """SIGTERM-first kill: a SIGKILLed process mid-device-claim can
+    wedge the tunnel harder (the claim is never released); give the
+    child a grace period to run its handlers before the hard kill.
+    Returns whatever stdout the child produced."""
+    proc.terminate()
+    try:
+        out, _ = proc.communicate(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    return out or ""
+
+
+def _death_record(detail: str, failed_bench: str, probe_err: dict) -> str:
+    return json.dumps(
+        {"metric": "backend_probe", "value": 0, "unit": "alive",
+         "vs_baseline": 0.0, "error": "backend died mid-run",
+         "detail": detail[-500:], "failed_bench": failed_bench,
+         **{"probe_" + k: v for k, v in probe_err.items()}})
+
+
 def probe_backend(timeout_s: float) -> dict | None:
     """Bounded-time liveness check of the JAX backend, in a subprocess.
 
@@ -75,15 +97,7 @@ def probe_backend(timeout_s: float) -> dict | None:
     try:
         out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        # SIGTERM first: a SIGKILLed process mid-device-claim can wedge
-        # the tunnel harder (the claim is never released); give the
-        # child a grace period to run its handlers before the hard kill
-        proc.terminate()
-        try:
-            proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.communicate()
+        _terminate(proc)
         return {"error": "backend unavailable",
                 "detail": f"device probe hung > {timeout_s:.0f}s "
                           "(wedged tunnel?)",
@@ -498,6 +512,69 @@ def bench_decode() -> dict:
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode}
 
+if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
+    # jax-free fixtures for tests/test_bench_probe.py's isolation tests
+    # (must exist in the CHILD processes too, hence env-gated, not
+    # monkeypatched)
+    BENCHES["selftest"] = lambda: {"metric": "selftest", "value": 1,
+                                   "unit": "ok", "vs_baseline": 1.0}
+
+    def _selftest_hang():
+        time.sleep(600)
+
+    BENCHES["selftest-hang"] = _selftest_hang
+
+
+def _run_isolated(names, per_bench_timeout: float,
+                  probe_timeout: float) -> int:
+    """Run each bench in ITS OWN subprocess with a hard timeout.
+
+    The pre-flight probe only protects the START of the window; a
+    backend that wedges MID-run leaves the process hung inside a jit
+    dispatch that nothing in-process can interrupt (round 4: the gpt
+    bench hung ~25 minutes after mnist failed, and the driver's own
+    timeout produced zero output).  Here the parent never initializes
+    JAX at all -- a hung bench costs its own timeout, is killed
+    SIGTERM-first, becomes one machine-readable error record, and the
+    remaining benches still run (after a confirming re-probe).
+    Exit code: 0 all pass, 1 some failed, 2 backend declared dead."""
+    failed = False
+    for name in names:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--benches", name, "--no-isolate", "--probe-timeout", "0"]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        timed_out = False
+        try:
+            out, _ = proc.communicate(timeout=per_bench_timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            out = _terminate(proc)
+        for line in (out or "").splitlines():
+            if line.strip():
+                print(line, flush=True)  # child records pass through
+        if timed_out:
+            failed = True
+            print(json.dumps(
+                {"metric": name, "value": 0, "unit": "error",
+                 "vs_baseline": 0.0, "error": "bench timed out",
+                 "detail": f"no result within {per_bench_timeout:.0f}s "
+                           "(mid-run wedge?)"}), flush=True)
+            # a hang strongly suggests a dead backend: confirm before
+            # burning the next bench's timeout on it too (probing
+            # disabled via --probe-timeout 0 = keep going, same as the
+            # in-process suspect-marker rule)
+            if probe_timeout > 0:
+                err = probe_backend(min(probe_timeout, 60))
+                if err is not None:
+                    print(_death_record("bench hang, probe confirmed",
+                                        name, err), flush=True)
+                    return 2
+        elif proc.returncode == 2:
+            return 2  # child already printed the death record
+        elif proc.returncode != 0:
+            failed = True
+    return 1 if failed else 0
+
 
 def main() -> None:
     parser = argparse.ArgumentParser()
@@ -509,6 +586,16 @@ def main() -> None:
                             "RLA_TPU_PROBE_TIMEOUT", "120")),
                         help="seconds before the pre-flight backend probe "
                              "declares the backend dead (0 disables)")
+    parser.add_argument("--no-isolate", action="store_true",
+                        help="run benches in THIS process instead of one "
+                             "subprocess each (isolation is the default "
+                             "so a mid-run backend wedge costs one "
+                             "bench's timeout, not the whole window)")
+    parser.add_argument("--bench-timeout", type=float,
+                        default=float(os.environ.get(
+                            "RLA_TPU_BENCH_TIMEOUT", "1200")),
+                        help="per-bench wall-clock limit in isolated "
+                             "mode (seconds)")
     args = parser.parse_args()
     if args.probe_timeout > 0:
         err = probe_backend(args.probe_timeout)
@@ -517,8 +604,12 @@ def main() -> None:
                               "unit": "alive", "vs_baseline": 0.0, **err}),
                   flush=True)
             sys.exit(2)
+    names = [b.strip() for b in args.benches.split(",") if b.strip()]
+    if not args.no_isolate:
+        sys.exit(_run_isolated(names, args.bench_timeout,
+                               args.probe_timeout))
     failed = False
-    for name in [b.strip() for b in args.benches.split(",") if b.strip()]:
+    for name in names:
         try:
             print(json.dumps(BENCHES[name]()), flush=True)
         except Exception as e:  # emit remaining benches; Ctrl-C still aborts
@@ -540,13 +631,7 @@ def main() -> None:
                         probe_backend(min(args.probe_timeout, 60))
                         if args.probe_timeout > 0 else None)
                 if err is not None:
-                    print(json.dumps(
-                        {"metric": "backend_probe", "value": 0,
-                         "unit": "alive", "vs_baseline": 0.0,
-                         "error": "backend died mid-run",
-                         "detail": msg[-500:], "failed_bench": name,
-                         **{"probe_" + k: v for k, v in err.items()}}),
-                        flush=True)
+                    print(_death_record(msg, name, err), flush=True)
                     sys.exit(2)
     if failed:
         sys.exit(1)

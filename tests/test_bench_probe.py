@@ -78,7 +78,7 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
     with a machine-readable record (no probe needed)."""
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "a,b",
-                         "--probe-timeout", "0"])
+                         "--probe-timeout", "0", "--no-isolate"])
 
     def dead():
         raise RuntimeError("Unable to initialize backend 'axon'")
@@ -101,7 +101,7 @@ def test_suspect_marker_with_probe_disabled_continues(monkeypatch,
     must NOT kill the remaining benches."""
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "a,b",
-                         "--probe-timeout", "0"])
+                         "--probe-timeout", "0", "--no-isolate"])
 
     def flaky():
         raise RuntimeError("DEADLINE_EXCEEDED then UNAVAILABLE retry")
@@ -118,3 +118,44 @@ def test_suspect_marker_with_probe_disabled_continues(monkeypatch,
     assert ran
     out = capsys.readouterr().out
     assert '"metric": "b"' in out
+
+
+def test_isolated_mode_survives_a_hung_bench(monkeypatch, capsys):
+    """Default (isolated) mode: a bench that HANGS -- the failure mode
+    no in-process machinery can interrupt -- costs its own timeout,
+    becomes an error record, and when the backend probe still passes,
+    the remaining benches run."""
+    monkeypatch.setenv("RLA_TPU_BENCH_SELFTEST", "1")
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "print('PROBE_OK 1.0 fake')")  # probe stays alive
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches",
+                         "selftest-hang,selftest",
+                         "--probe-timeout", "5", "--bench-timeout", "3"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1  # hang recorded as failure; selftest ran
+    lines = [json.loads(ln) for ln
+             in capsys.readouterr().out.splitlines() if ln.strip()]
+    by_metric = {r["metric"]: r for r in lines}
+    assert by_metric["selftest-hang"]["error"] == "bench timed out"
+    assert by_metric["selftest"]["value"] == 1
+
+
+def test_isolated_mode_passes_through_child_records(monkeypatch,
+                                                    capsys):
+    monkeypatch.setenv("RLA_TPU_BENCH_SELFTEST", "1")
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "print('PROBE_OK 1.0 fake')")
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "selftest",
+                         "--probe-timeout", "5"])
+    try:
+        bench.main()
+        code = 0
+    except SystemExit as e:
+        code = e.code
+    assert code == 0
+    rec = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert rec == {"metric": "selftest", "value": 1, "unit": "ok",
+                   "vs_baseline": 1.0}
