@@ -79,6 +79,7 @@ class Trainer:
                  enable_progress_bar: bool = False,
                  profiler: Optional["Profiler"] = None,
                  cache_dataset_on_device: Any = "auto",
+                 worker_deadline_s: Optional[float] = None,
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -135,6 +136,12 @@ class Trainer:
         # device-resident dataset cache: "auto" caches array-backed datasets
         # up to _CACHE_MAX_BYTES; True forces (when eligible), False disables
         self.cache_dataset_on_device = cache_dataset_on_device
+        # per-attempt wall-clock budget for a fanned-out fit/eval body: a
+        # rank busy past this is wedged -> reaped -> the attempt fails
+        # retryably with WorkerWedged instead of hanging the driver (see
+        # runtime/watchdog.py; stale-heartbeat detection additionally runs
+        # whenever RLA_TPU_WEDGE_TIMEOUT_S is set, deadline or not)
+        self.worker_deadline_s = worker_deadline_s
         self.seed = seed_everything(seed)
 
         if enable_checkpointing and not any(
@@ -149,6 +156,10 @@ class Trainer:
         self.sanity_checking = False
         self.fitting = False
         self.callback_metrics: Dict[str, float] = {}
+        # machine-readable record of the last fan-out stall (bench.py
+        # death-record shape, runtime/watchdog.stall_record); None while
+        # no supervised run has failed
+        self.last_stall_diagnosis: Optional[Dict[str, Any]] = None
         self.module: Optional[TpuModule] = None
         self._state: Optional[TrainState] = None
         self._mesh = None
@@ -587,9 +598,13 @@ class Trainer:
             # device claim doesn't deadlock against the driver's
             platform = worker_platform
             env["JAX_PLATFORMS"] = worker_platform
+            # driver-only XLA_FLAGS (e.g. host-platform device-count
+            # overrides keeping the driver CPU-side) must not leak into
+            # ANY worker platform -- a tpu/axon worker inheriting them
+            # would carry driver-side XLA configuration onto the chip
+            env["XLA_FLAGS"] = ""
             if worker_platform == "cpu":
                 cpu_per = spec.get("devices_per_host") or 1
-                env["XLA_FLAGS"] = ""
             return env, platform, cpu_per
         env_platform = os.environ.get("JAX_PLATFORMS",
                                       "").split(",")[0].lower()
@@ -640,18 +655,37 @@ class Trainer:
             self._world = world
         return world
 
-    def _run_in_world(self, world, module, body, queue):
+    def _run_in_world(self, world, module, body, queue, stage="fit"):
         """One entry-point run over the persistent world.  A failed run
         poisons the world's collectives (DistributedWorld kills itself);
         re-bind the stripped driver objects so the caller's trainer/module
-        still work locally afterwards."""
+        still work locally afterwards.  Runs under hang-aware supervision
+        when a per-attempt deadline (``worker_deadline_s``) or
+        ``RLA_TPU_WEDGE_TIMEOUT_S`` is configured; a stalled run surfaces
+        a machine-readable diagnosis on ``last_stall_diagnosis`` (and the
+        log) before re-raising."""
+        from ..runtime.watchdog import (WorkerWedged, stall_record)
+        self.last_stall_diagnosis = None
         try:
-            return world.run(body, queue=queue)
-        except BaseException:
+            return world.run(body, queue=queue,
+                             deadline_s=self.worker_deadline_s)
+        except BaseException as e:
             self._world = None
             module.trainer = self
             self.module = module
             self.fitting = False
+            if isinstance(e, (WorkerWedged, TimeoutError)):
+                import json
+                record = stall_record(e, stage)
+                # fold in the watchdog's reap records (per-rank beat/busy
+                # ages at kill time) gathered by the world
+                reaps = list(getattr(world, "last_stall", []))
+                if reaps and record.get("rank") is None:
+                    record["rank"] = reaps[0].get("rank")
+                record["reaped"] = reaps
+                self.last_stall_diagnosis = record
+                log.error("stall diagnosis: %s",
+                          json.dumps(record, sort_keys=True, default=str))
             raise
 
     def shutdown_workers(self) -> None:
@@ -683,7 +717,8 @@ class Trainer:
                                  world.ship_value(train_dataloaders),
                                  world.ship_value(val_dataloaders),
                                  world.ship_value(datamodule), ckpt_path)
-        results = self._run_in_world(world, module, body, queue)
+        results = self._run_in_world(world, module, body, queue,
+                                     stage="fit")
 
         # re-hydrate rank-0 state into the driver's trainer + module
         # (reference: ray_ddp.py:185-193)
@@ -728,7 +763,8 @@ class Trainer:
         body = functools.partial(_remote_eval_worker, self, module,
                                  world.ship_value(dataloaders),
                                  world.ship_value(datamodule), stage)
-        results = self._run_in_world(world, module, body, queue)
+        results = self._run_in_world(world, module, body, queue,
+                                     stage=stage)
 
         module.trainer = self
         self.module = module
@@ -1172,12 +1208,26 @@ class Trainer:
             out = jax.device_get(self._predict_step_fn(
                 params, self._put_batch(batch)))
             if true_n is not None:
-                # slice ONLY leaves carrying the padded per-sample axis;
-                # a leaf with some other leading dim (per-head stats, a
-                # pooled scalar) holds no padding to strip
-                out = jax.tree.map(
-                    lambda x: x[:true_n] if np.ndim(x)
-                    and np.shape(x)[0] == padded_n else x, out)
+                # strip padding only when every ARRAY leaf carries the
+                # padded per-sample axis (mirroring the input-side
+                # consistency check in _wrap_pad_batch): a leaf whose
+                # leading dim merely COINCIDES with padded_n (per-head
+                # stats of shape [16, ...] under a padded batch of 16)
+                # must not be silently truncated.  Scalar leaves have no
+                # leading axis to mis-truncate, so they pass through
+                # without vetoing the strip.
+                dims = {np.shape(x)[0] if np.ndim(x) else None
+                        for x in jax.tree.leaves(out)}
+                if dims - {None} == {padded_n}:
+                    out = jax.tree.map(
+                        lambda x: x[:true_n] if np.ndim(x) else x, out)
+                else:
+                    log.warning(
+                        "predict outputs carry no consistent padded "
+                        "per-sample axis (leading dims %s, padded batch "
+                        "%d); returning this batch's outputs with "
+                        "wrap-padding intact",
+                        sorted(dims, key=str), padded_n)
             outs.append(out)
         return outs
 

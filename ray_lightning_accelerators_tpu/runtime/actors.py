@@ -41,11 +41,15 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import cloudpickle
 
 from ..utils.logging import log
+from .watchdog import (HeartbeatChannel, WorkerBeat, WorkerWedged,
+                       heartbeat_interval_s)
 
 _SENTINEL = b"__shutdown__"
 
 
-def _worker_main(conn, env: Dict[str, str]) -> None:
+def _worker_main(conn, env: Dict[str, str], rank: int = 0,
+                 heartbeat: Optional[HeartbeatChannel] = None,
+                 heartbeat_s: float = 0.0) -> None:
     os.environ.update(env)
     # a device plugin loaded from sitecustomize may have forced
     # jax_platforms via CONFIG during interpreter startup; the
@@ -59,6 +63,22 @@ def _worker_main(conn, env: Dict[str, str]) -> None:
             jax.config.update("jax_platforms", platforms)
         except Exception:
             pass
+    beat = None
+    if heartbeat is not None and heartbeat_s > 0:
+        beat = WorkerBeat(heartbeat, heartbeat_s)
+        beat.start()
+    # deterministic fault injection (testing/chaos.py), imported ONLY when
+    # requested -- the test harness must not be a production dependency.
+    # A broken spec surfaces on the first dispatch's future, not by
+    # killing the worker silently.
+    chaos = chaos_error = None
+    if os.environ.get("RLA_TPU_CHAOS"):
+        try:
+            from ..testing.chaos import ChaosInjector
+            chaos = ChaosInjector.from_env(
+                rank, freeze_heartbeat=beat.freeze if beat else None)
+        except BaseException as e:
+            chaos_error = e
     while True:
         try:
             blob = conn.recv_bytes()
@@ -68,6 +88,8 @@ def _worker_main(conn, env: Dict[str, str]) -> None:
             conn.close()
             return
         try:
+            if chaos_error is not None:
+                raise chaos_error
             fn, args, kwargs = cloudpickle.loads(blob)
             # Ray-style call-site deref: top-level ObjectRef args resolve
             # from the shared-memory store (reference: ray.put'd trainer_ref
@@ -75,11 +97,24 @@ def _worker_main(conn, env: Dict[str, str]) -> None:
             from .object_store import resolve
             args = tuple(resolve(a) for a in args)
             kwargs = {k: resolve(v) for k, v in kwargs.items()}
+            # busy marker brackets the USER work only: deserialization
+            # above imports the fn's module graph, and counting that
+            # cold-start cost against a dispatch deadline would wedge
+            # every freshly restarted (healthy) worker on its first
+            # dispatch -- retries could then never converge.  A hung
+            # loads is still bounded by the driver-side deadline
+            # backstops (queue.process_results / world.run).
+            if beat is not None:
+                beat.begin_dispatch()
+            if chaos is not None:
+                chaos.on_dispatch()
             result = fn(*args, **kwargs)
             payload = ("ok", cloudpickle.dumps(result))
         except BaseException as e:  # ship the traceback home
             payload = ("err", cloudpickle.dumps(
                 (type(e).__name__, str(e), traceback.format_exc())))
+        if beat is not None:
+            beat.end_dispatch()
         conn.send_bytes(cloudpickle.dumps(payload))
 
 
@@ -96,10 +131,15 @@ class Worker:
     """One persistent subprocess executing shipped callables in order."""
 
     def __init__(self, rank: int, env: Optional[Dict[str, str]] = None,
-                 ctx: Optional[Any] = None):
+                 ctx: Optional[Any] = None,
+                 heartbeat_s: Optional[float] = None):
         self.rank = rank
         self._env = dict(env or {})  # kept for restart()
         self._ctx = ctx or mp.get_context("spawn")
+        # liveness channel interval: explicit arg > per-worker env >
+        # process env > default; <= 0 disables the channel entirely
+        self._heartbeat_s = (heartbeat_s if heartbeat_s is not None
+                             else heartbeat_interval_s(self._env))
         # Two locks: _state_lock guards _pending (held only for list ops, so
         # the collector can always drain the pipe even while a sender is
         # blocked on a full pipe buffer -- holding one lock across a blocking
@@ -112,18 +152,29 @@ class Worker:
 
     def _spawn(self) -> None:
         self._conn, child_conn = self._ctx.Pipe()
+        # fresh heartbeat channel per generation: a restarted worker starts
+        # with a clean beat (watchdog state resets with the process)
+        self.heartbeat = (HeartbeatChannel(self._ctx)
+                          if self._heartbeat_s > 0 else None)
         self._proc = self._ctx.Process(
-            target=_worker_main, args=(child_conn, self._env),
+            target=_worker_main,
+            args=(child_conn, self._env, self.rank, self.heartbeat,
+                  self._heartbeat_s),
             daemon=True, name=f"rla-tpu-worker-{self.rank}")
         self._proc.start()
         child_conn.close()
         self._pending: List[Future] = []
+        # per-generation metadata shared with THIS generation's collector:
+        # a watchdog reap marks the wedge diagnosis here so the collector
+        # fails the generation's futures with WorkerWedged, not 'died'
+        self._meta: Dict[str, Any] = {"wedge": None}
         # the collector binds ITS generation's pipe/pending/process: after a
         # restart() swaps them on self, the old thread must keep draining the
         # old pipe (to fail the old futures), not the new one
         self._collector = threading.Thread(
             target=self._collect,
-            args=(self._conn, self._proc, self._pending), daemon=True)
+            args=(self._conn, self._proc, self._pending, self._meta),
+            daemon=True)
         self._collector.start()
 
     @property
@@ -187,7 +238,7 @@ class Worker:
                     f"worker {self.rank} died before accepting work: {e}"))
         return fut
 
-    def _collect(self, conn, proc, pending_list) -> None:
+    def _collect(self, conn, proc, pending_list, meta=None) -> None:
         while True:
             try:
                 blob = conn.recv_bytes()
@@ -195,8 +246,16 @@ class Worker:
                 with self._state_lock:
                     pending = list(pending_list)
                     pending_list.clear()
+                    wedge = (meta or {}).get("wedge")
                 for fut, _raw in pending:
-                    if not fut.done():
+                    if fut.done():
+                        continue
+                    if wedge is not None:
+                        # deliberate watchdog kill of an alive-but-stuck
+                        # process: callers must see a wedge, not a death
+                        fut.set_exception(
+                            WorkerWedged.for_rank(self.rank, wedge))
+                    else:
                         fut.set_exception(RuntimeError(
                             f"worker {self.rank} died "
                             f"(exitcode={proc.exitcode})"))
@@ -227,6 +286,16 @@ class Worker:
 
     def get_node_ip(self) -> str:
         return self.execute(_node_ip).result()
+
+    def reap(self, diagnosis: Optional[Dict[str, Any]] = None) -> None:
+        """Deliberate SIGTERM-then-SIGKILL of an alive-but-stuck worker
+        (the watchdog's kill path).  Unlike a spontaneous death, pending
+        futures fail with ``WorkerWedged`` carrying the diagnosis, so
+        retry layers can tell a wedge from a crash.  The worker stays
+        restartable (``restart()`` respawns with rank/env intact)."""
+        with self._state_lock:
+            self._meta["wedge"] = dict(diagnosis or {})
+        self.kill()
 
     def kill(self) -> None:
         if self._proc.is_alive():
@@ -332,8 +401,17 @@ class ActorPool:
     # SURVEY.md §5.3; first-class here)                                  #
     # ------------------------------------------------------------------ #
     def health_check(self) -> List[bool]:
-        """Liveness per rank, detected from the OS process state."""
+        """Liveness per rank, detected from the OS process state.  Note
+        this only sees DEAD workers; a wedged (alive-but-stuck) rank needs
+        progress-based supervision -- see ``watch()``."""
         return [w.is_alive for w in self.workers]
+
+    def watch(self, **kwargs) -> "Any":
+        """A started ``runtime.watchdog.Watchdog`` over this pool: per-rank
+        ``ok | slow | wedged | dead`` classification from heartbeats, with
+        wedged ranks reaped so their futures fail ``WorkerWedged``."""
+        from .watchdog import Watchdog
+        return Watchdog(self, **kwargs).start()
 
     def restart_dead(self, init_hook: Optional[Callable[[], None]] = None) \
             -> List[int]:

@@ -241,6 +241,16 @@ class HostAgent:
                     elif op == "alive":
                         reply(req_id, "ok", worker is not None
                               and worker.is_alive)
+                    elif op == "heartbeat":
+                        # snapshot taken HERE so only clock-free ages cross
+                        # the wire (driver and agent clocks need not agree)
+                        hb = getattr(worker, "heartbeat", None)
+                        reply(req_id, "ok",
+                              None if hb is None else hb.snapshot())
+                    elif op == "reap":
+                        if worker is not None:
+                            worker.reap(payload)
+                        reply(req_id, "ok", None)
                     elif op == "restart":
                         worker.restart()
                         reply(req_id, "ok", None)
@@ -351,6 +361,7 @@ class AgentConnection:
 
     def _recv_loop(self) -> None:
         from .actors import RemoteError
+        from .watchdog import WorkerWedged
 
         while True:
             try:
@@ -380,7 +391,14 @@ class AgentConnection:
                     fut.set_result(cloudpickle.loads(payload))
                 else:
                     name, msg, tb = cloudpickle.loads(payload)
-                    fut.set_exception(RemoteError(name, msg, tb))
+                    if name == "WorkerWedged":
+                        # an agent-side watchdog reap crossed the relay as
+                        # (name, str, tb); rebuild the typed wedge (with
+                        # its embedded diagnosis) so driver-side retry
+                        # layers classify it correctly
+                        fut.set_exception(WorkerWedged.from_message(msg))
+                    else:
+                        fut.set_exception(RemoteError(name, msg, tb))
             except BaseException as e:
                 fut.set_exception(RuntimeError(
                     f"failed to deserialize result from agent "
@@ -408,6 +426,9 @@ class RemoteWorker:
         self._env = dict(env or {})
         self._conn = AgentConnection(address)
         self._conn.call("spawn", (rank, self._env))
+        # Watchdog parity: snapshots are taken agent-side (ages only);
+        # an unreachable agent degrades to liveness-only supervision
+        self.heartbeat = _RemoteHeartbeat(self._conn)
 
     # -- Worker parity surface ---------------------------------------- #
     def execute(self, fn, *args, **kwargs) -> Future:
@@ -435,6 +456,15 @@ class RemoteWorker:
     def restart(self) -> None:
         self._conn.call("restart", timeout=60)
 
+    def reap(self, diagnosis: Optional[Dict] = None) -> None:
+        """Watchdog kill of a wedged remote worker.  The agent connection
+        stays open (unlike ``kill``): the worker slot remains restartable
+        through the same agent, mirroring the local ``Worker.reap``."""
+        try:
+            self._conn.call("reap", diagnosis, timeout=30)
+        except BaseException:
+            pass  # agent gone: the lost connection already failed futures
+
     def set_env_var(self, key: str, value: str) -> Future:
         return self.execute(_set_env_remote, key, value)
 
@@ -456,6 +486,25 @@ class RemoteWorker:
         self._conn.close()
 
 
+class _RemoteHeartbeat:
+    """Driver-side heartbeat proxy for a worker on a HostAgent: snapshots
+    are computed agent-side (only ages cross the wire).  Failures return
+    None -- the watchdog then falls back to liveness-only supervision for
+    this rank rather than false-positive killing on a slow network."""
+
+    def __init__(self, conn: AgentConnection):
+        self._conn = conn
+
+    def snapshot(self) -> Optional[Dict]:
+        try:
+            # short timeout: the watchdog polls every rank sequentially,
+            # so one partitioned agent must not stall wedge detection for
+            # the healthy ranks by 10s-per-poll
+            return self._conn.call("heartbeat", timeout=2)
+        except BaseException:
+            return None
+
+
 def _set_env_remote(key: str, value: str) -> None:
     os.environ[key] = value
 
@@ -471,7 +520,34 @@ def agents_from_env() -> Optional[List[str]]:
 
 
 def is_loopback(host: str) -> bool:
-    return host in ("localhost",) or host.startswith("127.")
+    """True only when ``host`` genuinely names the loopback interface.
+
+    This feeds the tokenless-bind RCE gate, so it must not be foolable by
+    prefix tricks: a hostname like ``127.evil.example`` can resolve to a
+    public IP, and ``::1`` IS loopback.  Literal addresses are classified
+    with ``ipaddress``; hostnames are resolved and count as loopback only
+    when EVERY resolved address is (fail closed: unresolvable = not
+    loopback, which at worst demands a token for a bind that didn't need
+    one)."""
+    import ipaddress
+    host = host.strip().strip("[]")  # bracketed IPv6 literals
+    if host == "localhost":
+        return True
+    try:
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        pass  # not a literal: resolve it
+    try:
+        infos = socket.getaddrinfo(host, None)
+    except socket.gaierror:
+        return False
+    addrs = {info[4][0] for info in infos}
+    try:
+        return bool(addrs) and all(
+            ipaddress.ip_address(a.split("%")[0]).is_loopback
+            for a in addrs)
+    except ValueError:
+        return False
 
 
 def check_tokenless_wide_bind(what: str, bind: str,

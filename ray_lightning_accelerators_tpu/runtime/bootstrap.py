@@ -53,8 +53,18 @@ def initialize_worker(coordinator_address: str, num_processes: int,
         if platform == "cpu":
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
             if cpu_devices_per_process:
-                jax.config.update("jax_num_cpu_devices",
-                                  cpu_devices_per_process)
+                try:
+                    jax.config.update("jax_num_cpu_devices",
+                                      cpu_devices_per_process)
+                except AttributeError:
+                    # pre-0.5 jax: the XLA flag is the only spelling; this
+                    # runs before the worker's backend initializes, so the
+                    # env route still takes effect
+                    flags = os.environ.get("XLA_FLAGS", "")
+                    if "xla_force_host_platform_device_count" not in flags:
+                        os.environ["XLA_FLAGS"] = (
+                            flags + " --xla_force_host_platform_device_"
+                            f"count={cpu_devices_per_process}").strip()
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
@@ -323,11 +333,22 @@ class DistributedWorld:
 
     def run(self, trainable: Callable[[int], Any],
             queue: Optional[TrampolineQueue] = None,
-            init_hook: Optional[Callable[[], None]] = None) -> List[Any]:
+            init_hook: Optional[Callable[[], None]] = None,
+            deadline_s: Optional[float] = None,
+            wedge_timeout_s: Optional[float] = None) -> List[Any]:
         """Fan ``trainable(process_id)`` over the live world.  Returns
         per-rank results, rank 0 first.  With a ``queue``, every worker
         gets a session whose trampoline reaches this driver over TCP, so
-        tune callbacks work unchanged through remote workers."""
+        tune callbacks work unchanged through remote workers.
+
+        Hang-aware supervision (`runtime.watchdog`) runs when
+        ``deadline_s`` (per-attempt budget for this run's dispatch),
+        ``wedge_timeout_s`` (stale-heartbeat threshold), or the
+        ``RLA_TPU_WEDGE_TIMEOUT_S`` env is set: a rank that stops making
+        progress is reaped and fails the run with ``WorkerWedged``
+        (retryable) instead of hanging the driver forever.  A padded
+        driver-side ``process_results`` deadline backstops the case where
+        the supervision channel itself is broken."""
         # liveness was checked by the caller (_acquire_world) moments ago;
         # re-probing here would cost another N agent round-trips per entry
         # point, and a racing death still surfaces as a dispatch failure
@@ -345,12 +366,26 @@ class DistributedWorld:
                                   bind=queue_bind_for_agents(self.agents),
                                   query_handler=_nested_query_handler())
             queue_address = qserver.address
+        from .watchdog import Watchdog, wedge_timeout_from_env
+        if wedge_timeout_s is None:
+            wedge_timeout_s = wedge_timeout_from_env()
+        watchdog: Optional[Watchdog] = None
+        self.last_stall: List[Dict[str, Any]] = []
         try:
             futures = self.pool.execute_per_worker(
                 _run_world_body,
                 [(i, trainable, queue_address, init_hook)
                  for i in range(self.num_processes)])
-            return process_results(futures, queue)
+            if deadline_s is not None or wedge_timeout_s is not None:
+                watchdog = Watchdog(
+                    self.pool, wedge_timeout_s=wedge_timeout_s,
+                    dispatch_deadline_s=deadline_s).start()
+            # backstop deadline, padded past the watchdog's trigger so
+            # the typed WorkerWedged (with diagnosis) wins when possible
+            hard_deadline = (deadline_s + max(30.0, wedge_timeout_s or 0.0)
+                             if deadline_s is not None else None)
+            return process_results(futures, queue,
+                                   deadline_s=hard_deadline)
         except BaseException:
             # a crashed rank leaves its peers blocked in the distributed
             # barrier; they will never drain a shutdown sentinel -- kill
@@ -358,6 +393,9 @@ class DistributedWorld:
             self.kill()
             raise
         finally:
+            if watchdog is not None:
+                watchdog.stop()
+                self.last_stall = list(watchdog.reaped)
             if qserver is not None:
                 qserver.close()
 

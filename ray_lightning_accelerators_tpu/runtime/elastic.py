@@ -9,8 +9,14 @@ primitives the runtime provides:
 
 - failure *detection*: a dead worker fails its futures with 'worker died'
   (runtime/actors.py collector) and shows dead in ``pool.health_check()``;
+  a HUNG worker -- alive but stopped making progress -- is detected by a
+  per-attempt `runtime.watchdog.Watchdog` (stale heartbeat or overrun
+  dispatch deadline), reaped, and fails its futures with ``WorkerWedged``,
+  so wedges retry exactly like crashes instead of hanging the driver;
 - worker *restart*: ``pool.restart_dead()`` respawns crashed ranks with
-  their rank/env intact.
+  their rank/env intact; retries use ``pool.restart_all()`` because the
+  wedge/crash survivors of a broken collective are alive-but-stuck and
+  must be cleared deliberately, not left to hang the re-dispatch.
 
 Recovery is checkpoint-based, matching the framework's training semantics:
 a collective (SPMD) step cannot survive losing a participant mid-step, so
@@ -23,11 +29,12 @@ Trainer.fit(ckpt_path="last")).
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..utils.logging import log
 from .actors import ActorPool
 from .queue import TrampolineQueue, process_results
+from .watchdog import Watchdog, wedge_timeout_from_env
 
 
 class ElasticRunner:
@@ -37,18 +44,39 @@ class ElasticRunner:
                  backoff_s: float = 0.0,
                  on_failure: Optional[Callable[[int, BaseException], None]]
                  = None,
-                 init_hook: Optional[Callable[[], None]] = None):
+                 init_hook: Optional[Callable[[], None]] = None,
+                 wedge_timeout_s: Optional[float] = None,
+                 dispatch_deadline_s: Optional[float] = None,
+                 watchdog_poll_s: Optional[float] = None):
         """``max_failures``: attempts beyond the first before giving up.
         ``on_failure(attempt, exc)``: observer hook per failed attempt.
         ``init_hook``: re-run on restarted workers before re-dispatch
         (parity with the accelerator's per-worker init_hook,
-        reference: ray_lightning/ray_ddp.py:106-107)."""
+        reference: ray_lightning/ray_ddp.py:106-107).
+
+        Hang-aware supervision runs when any of ``wedge_timeout_s``
+        (stale-heartbeat threshold), ``dispatch_deadline_s`` (per-attempt
+        budget for the dispatched fn), or the ``RLA_TPU_WEDGE_TIMEOUT_S``
+        env is set: each attempt is watched by a `runtime.watchdog
+        .Watchdog`, wedged ranks are reaped, and the attempt fails
+        retryably with ``WorkerWedged`` instead of hanging forever."""
         self.pool = pool
         self.max_failures = max_failures
         self.backoff_s = backoff_s
         self.on_failure = on_failure
         self.init_hook = init_hook
+        self.wedge_timeout_s = wedge_timeout_s
+        self.dispatch_deadline_s = dispatch_deadline_s
+        self.watchdog_poll_s = watchdog_poll_s
         self.attempts_used = 0
+        # wedge diagnosis records accumulated across attempts (one dict
+        # per reaped rank, runtime/watchdog.py death-record shape)
+        self.wedge_events: List[Dict[str, Any]] = []
+
+    def _supervised(self) -> bool:
+        return (self.wedge_timeout_s is not None
+                or self.dispatch_deadline_s is not None
+                or wedge_timeout_from_env() is not None)
 
     def run(self, fn: Callable,
             args_per_worker: Optional[Callable[[int], Sequence[tuple]]]
@@ -68,24 +96,48 @@ class ElasticRunner:
                 if self.backoff_s:
                     time.sleep(self.backoff_s * attempt)
                 # restart every rank, not just dead ones: survivors of a
-                # broken collective are alive-but-wedged and would never
-                # dequeue the retry
+                # broken collective (and watchdog-reaped wedges' peers)
+                # are alive-but-stuck and would never dequeue the retry --
+                # clearing them is deliberate, not a side effect
                 restarted = self.pool.restart_all(init_hook=self.init_hook)
                 log.warning("elastic attempt %d/%d (restarted ranks %s)",
                             attempt + 1, self.max_failures + 1, restarted)
+            watchdog: Optional[Watchdog] = None
             try:
                 if args_per_worker is not None:
                     futures = self.pool.execute_per_worker(
                         fn, args_per_worker(attempt))
                 else:
                     futures = self.pool.execute_all(fn)
-                return process_results(futures, queue)
+                hard_deadline = None
+                if self._supervised():
+                    # per-attempt watchdog: started after dispatch,
+                    # stopped before any restart touches the pool
+                    watchdog = Watchdog(
+                        self.pool,
+                        wedge_timeout_s=self.wedge_timeout_s,
+                        dispatch_deadline_s=self.dispatch_deadline_s,
+                        poll_s=self.watchdog_poll_s).start()
+                    if self.dispatch_deadline_s is not None:
+                        # driver-side backstop, padded past the reap
+                        # trigger so the typed WorkerWedged wins when the
+                        # channel works -- but a heartbeat-less hang
+                        # still fails the attempt (retryably) instead of
+                        # blocking the driver forever
+                        hard_deadline = self.dispatch_deadline_s + max(
+                            30.0, watchdog.wedge_timeout_s)
+                return process_results(futures, queue,
+                                       deadline_s=hard_deadline)
             except BaseException as e:  # noqa: BLE001 — resurfaced below
                 last_exc = e
                 if self.on_failure is not None:
                     self.on_failure(attempt, e)
                 if attempt == self.max_failures:
                     break
+            finally:
+                if watchdog is not None:
+                    watchdog.stop()
+                    self.wedge_events.extend(watchdog.reaped)
         raise RuntimeError(
             f"elastic run failed after {self.max_failures + 1} attempts"
         ) from last_exc

@@ -248,7 +248,8 @@ def drain_queue(q: Optional[TrampolineQueue]) -> int:
 
 
 def process_results(futures: List[Future], q: Optional[TrampolineQueue],
-                    poll_s: float = 0.01) -> List[Any]:
+                    poll_s: float = 0.01,
+                    deadline_s: Optional[float] = None) -> List[Any]:
     """Poll training futures while draining the trampoline queue; final drain
     after completion closes the enqueue/finish race
     (reference: util.py:96-109).
@@ -257,8 +258,17 @@ def process_results(futures: List[Future], q: Optional[TrampolineQueue],
     reference: util.py:103): in a collective job one crashed rank leaves its
     peers blocked in a barrier forever, so waiting for all futures would
     hang the driver with the failure already in hand.
+
+    ``deadline_s``: monotonic wall-clock budget for the WHOLE set.  The
+    watchdog normally fails a hung rank's futures first (WorkerWedged);
+    this is the driver-side backstop for when heartbeats are disabled or
+    the supervision channel itself is broken -- raises ``TimeoutError``
+    with the unresolved ranks still pending (callers kill/restart the
+    workers; the futures themselves stay unresolved).
     """
     pending = list(futures)
+    deadline = (time.monotonic() + deadline_s
+                if deadline_s is not None else None)
     while pending:
         drain_queue(q)
         still = []
@@ -270,6 +280,13 @@ def process_results(futures: List[Future], q: Optional[TrampolineQueue],
             else:
                 still.append(f)
         pending = still
+        if pending and deadline is not None \
+                and time.monotonic() >= deadline:
+            drain_queue(q)
+            raise TimeoutError(
+                f"process_results: {len(pending)} of {len(futures)} "
+                f"futures unresolved past the {deadline_s:.1f}s deadline "
+                "(workers hung without tripping the watchdog?)")
         if pending:
             time.sleep(poll_s)
     drain_queue(q)

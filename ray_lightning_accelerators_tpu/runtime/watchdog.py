@@ -1,0 +1,440 @@
+"""Liveness subsystem: detect workers that stopped making PROGRESS.
+
+The actor runtime's failure detection is process-liveness only: a dead
+worker fails its futures ("worker died", runtime/actors.py collector) and
+shows dead in ``ActorPool.health_check()``.  A worker wedged *inside* a
+dispatched fn -- stuck in a broken collective, a hung TPU dispatch, a
+deadlocked data pipeline -- never fails its future, so the driver waits
+forever (the failure mode bench.py already guards against with subprocess
+isolation; this module is the same upgrade for the training runtime,
+mirroring the stall-detection-first design of eager-SPMD runtimes such as
+veScale, PAPERS.md).
+
+Three pieces:
+
+- **HeartbeatChannel**: shared-memory beat between each worker process and
+  the driver.  A worker-side daemon thread (``WorkerBeat``) stamps a
+  monotonic beat every ``RLA_TPU_WORKER_HEARTBEAT_S``; the dispatch loop
+  brackets every execution with a busy-since marker and a dispatch
+  counter.  CLOCK_MONOTONIC is system-wide, so driver-side age reads need
+  no cross-process clock agreement; for workers on OTHER machines the
+  snapshot is taken agent-side and only *ages* cross the wire
+  (runtime/agent.py ``heartbeat`` op).
+- **Watchdog**: a driver-side thread classifying each rank
+  ``ok | slow | wedged | dead`` from (process liveness, beat age, busy
+  duration).  A rank is *wedged* when its beat went stale past
+  ``RLA_TPU_WEDGE_TIMEOUT_S`` (frozen process) or a dispatch overran an
+  explicit per-dispatch deadline (hung work).  Wedged ranks are reaped --
+  SIGTERM-then-SIGKILL via ``Worker.reap`` -- so their pending futures
+  fail with **WorkerWedged** (distinct from ``RemoteError``/died) and
+  ``ElasticRunner`` retries exactly like a crash.
+- **Diagnosis records**: every reap produces a machine-readable dict
+  (bench.py death-record shape: ``error``/``detail`` plus ``stall_*``
+  context) surfaced on the exception, the watchdog (``.reaped``), and
+  ``Trainer.last_stall_diagnosis``.
+
+State transitions are condition-signaled (``wait_for_state``), so tests
+assert on events and monotonic deadlines, never sleep-poll loops.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import log
+
+HEARTBEAT_ENV = "RLA_TPU_WORKER_HEARTBEAT_S"
+WEDGE_ENV = "RLA_TPU_WEDGE_TIMEOUT_S"
+DEFAULT_HEARTBEAT_S = 1.0
+DEFAULT_WEDGE_TIMEOUT_S = 60.0
+
+STATE_OK = "ok"
+STATE_SLOW = "slow"
+STATE_WEDGED = "wedged"
+STATE_DEAD = "dead"
+
+
+def heartbeat_interval_s(env: Optional[Dict[str, str]] = None) -> float:
+    """Beat interval; a per-worker env overrides the process env.
+    ``<= 0`` disables the channel entirely (liveness-only supervision)."""
+    raw = None
+    if env:
+        raw = env.get(HEARTBEAT_ENV)
+    if raw is None:
+        raw = os.environ.get(HEARTBEAT_ENV)
+    try:
+        return float(raw) if raw not in (None, "") else DEFAULT_HEARTBEAT_S
+    except ValueError:
+        log.warning("bad %s=%r; using %.1fs", HEARTBEAT_ENV, raw,
+                    DEFAULT_HEARTBEAT_S)
+        return DEFAULT_HEARTBEAT_S
+
+
+def wedge_timeout_from_env() -> Optional[float]:
+    """The env-configured wedge threshold, or None when unset (supervision
+    stays opt-in for entry points that only watch when configured)."""
+    raw = os.environ.get(WEDGE_ENV, "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("bad %s=%r; ignoring", WEDGE_ENV, raw)
+        return None
+
+
+class WorkerWedged(RuntimeError):
+    """A rank was alive but stopped making progress and was killed by the
+    watchdog.  Distinct from ``RemoteError`` (worker-side exception) and
+    the generic 'worker died' (process exited on its own): callers such as
+    ``ElasticRunner`` treat it as a retryable whole-attempt failure."""
+
+    _MARKER = "| diagnosis="
+
+    def __init__(self, message: str, rank: Optional[int] = None,
+                 diagnosis: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.rank = rank
+        self.diagnosis = dict(diagnosis or {})
+
+    @classmethod
+    def for_rank(cls, rank: int,
+                 diagnosis: Dict[str, Any]) -> "WorkerWedged":
+        diagnosis = dict(diagnosis)
+        diagnosis.setdefault("rank", rank)
+        detail = diagnosis.get("detail", "stopped making progress")
+        msg = (f"worker {rank} wedged (killed by watchdog): {detail} "
+               f"{cls._MARKER}{json.dumps(diagnosis, sort_keys=True, default=str)}")
+        return cls(msg, rank=rank, diagnosis=diagnosis)
+
+    @classmethod
+    def from_message(cls, message: str) -> "WorkerWedged":
+        """Rebuild from a message that crossed a wire as (name, str, tb) --
+        the agent relay path -- recovering the embedded diagnosis."""
+        diagnosis: Dict[str, Any] = {}
+        i = message.find(cls._MARKER)
+        if i >= 0:
+            try:
+                diagnosis = json.loads(message[i + len(cls._MARKER):])
+            except ValueError:
+                pass
+        return cls(message, rank=diagnosis.get("rank"), diagnosis=diagnosis)
+
+
+# --------------------------------------------------------------------- #
+# Heartbeat channel (shared memory, driver <-> worker process)           #
+# --------------------------------------------------------------------- #
+class HeartbeatChannel:
+    """Three shared scalars: last beat stamp, busy-since marker (0 = idle),
+    dispatch counter.  Created driver-side with the pool's mp context so
+    it ships through spawn ``Process`` args; stamped worker-side; read
+    driver-side as ages against the shared CLOCK_MONOTONIC."""
+
+    def __init__(self, ctx: Optional[Any] = None):
+        ctx = ctx or mp.get_context("spawn")
+        now = time.monotonic()
+        self._beat = ctx.Value("d", now)
+        self._busy_since = ctx.Value("d", 0.0)
+        self._dispatches = ctx.Value("L", 0)
+        # flips on the worker's FIRST stamp: until then the process is
+        # booting (interpreter spawn + imports can take tens of seconds)
+        # and staleness is judged against the watchdog's boot grace, not
+        # the wedge timeout
+        self._started = ctx.Value("b", 0)
+
+    # -- worker side --------------------------------------------------- #
+    def stamp(self) -> None:
+        self._beat.value = time.monotonic()
+        self._started.value = 1
+
+    def begin_dispatch(self) -> None:
+        now = time.monotonic()
+        with self._dispatches.get_lock():
+            self._dispatches.value += 1
+        self._busy_since.value = now
+        self._beat.value = now
+        self._started.value = 1
+
+    def end_dispatch(self) -> None:
+        self._busy_since.value = 0.0
+        self._beat.value = time.monotonic()
+
+    # -- driver side --------------------------------------------------- #
+    def snapshot(self) -> Dict[str, Any]:
+        """Ages, not absolute times: safe to relay across machines."""
+        now = time.monotonic()
+        beat = self._beat.value
+        busy = self._busy_since.value
+        return {
+            "beat_age_s": max(0.0, now - beat),
+            "busy_s": max(0.0, now - busy) if busy > 0.0 else None,
+            "dispatches": int(self._dispatches.value),
+            "started": bool(self._started.value),
+        }
+
+
+class WorkerBeat:
+    """Worker-process side: a daemon thread stamping the channel every
+    ``interval_s``.  ``freeze()`` stops stamping permanently -- used by
+    chaos 'hang' injection to simulate a fully frozen process (a real
+    frozen process stops beating by definition)."""
+
+    def __init__(self, channel: HeartbeatChannel, interval_s: float):
+        self.channel = channel
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._frozen = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.channel.stamp()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rla-tpu-heartbeat")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if not self._frozen.is_set():
+                self.channel.stamp()
+
+    def begin_dispatch(self) -> None:
+        if not self._frozen.is_set():
+            self.channel.begin_dispatch()
+
+    def end_dispatch(self) -> None:
+        if not self._frozen.is_set():
+            self.channel.end_dispatch()
+
+    def freeze(self) -> None:
+        self._frozen.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# --------------------------------------------------------------------- #
+# Watchdog (driver side)                                                 #
+# --------------------------------------------------------------------- #
+class Watchdog:
+    """Classify every rank of a pool ``ok | slow | wedged | dead`` and
+    (by default) reap wedged ranks so their futures fail ``WorkerWedged``.
+
+    ``wedge_timeout_s``: beat staleness past this = frozen process ->
+    wedged (default ``RLA_TPU_WEDGE_TIMEOUT_S``, else 60s).
+    ``dispatch_deadline_s``: a single dispatched fn busy past this ->
+    wedged.  None (default) = dispatches may run arbitrarily long
+    (a legitimate fit body is one long dispatch); only beat staleness
+    and process death are failures then.
+    ``slow_after_s``: busy past this = ``slow`` (advisory straggler
+    signal, never killed); defaults to half the wedge trigger.
+    ``auto_reap``: SIGTERM-then-SIGKILL wedged ranks (via
+    ``worker.reap``) and record a diagnosis; False = observe only.
+    ``boot_grace_s``: staleness threshold while a worker process has
+    never beaten -- interpreter spawn plus imports legitimately take
+    tens of seconds, so judging boot by the wedge timeout would kill
+    healthy workers mid-import.
+    """
+
+    def __init__(self, workers: Any,
+                 wedge_timeout_s: Optional[float] = None,
+                 dispatch_deadline_s: Optional[float] = None,
+                 slow_after_s: Optional[float] = None,
+                 poll_s: Optional[float] = None,
+                 auto_reap: bool = True,
+                 boot_grace_s: float = 120.0,
+                 on_transition: Optional[
+                     Callable[[int, str, str], None]] = None):
+        self.workers = list(getattr(workers, "workers", workers))
+        if wedge_timeout_s is None:
+            wedge_timeout_s = wedge_timeout_from_env()
+        if wedge_timeout_s is None:
+            wedge_timeout_s = DEFAULT_WEDGE_TIMEOUT_S
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.dispatch_deadline_s = dispatch_deadline_s
+        trigger = (dispatch_deadline_s if dispatch_deadline_s is not None
+                   else self.wedge_timeout_s)
+        self.slow_after_s = (slow_after_s if slow_after_s is not None
+                             else trigger / 2.0)
+        if poll_s is None:
+            candidates = [self.wedge_timeout_s / 4.0]
+            if dispatch_deadline_s is not None:
+                candidates.append(dispatch_deadline_s / 4.0)
+            poll_s = min(1.0, max(0.02, min(candidates)))
+        self.poll_s = poll_s
+        self.auto_reap = auto_reap
+        self.boot_grace_s = max(boot_grace_s, self.wedge_timeout_s)
+        self.on_transition = on_transition
+        self.reaped: List[Dict[str, Any]] = []
+        self._states: Dict[int, str] = {
+            w.rank: STATE_OK for w in self.workers}
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- classification ------------------------------------------------ #
+    def classify(self, worker: Any) -> Tuple[str, Dict[str, Any]]:
+        """Pure classification of one worker's current snapshot."""
+        try:
+            alive = worker.is_alive
+        except BaseException:
+            alive = False
+        if not alive:
+            return STATE_DEAD, {
+                "detail": "process dead "
+                          f"(exitcode={getattr(worker, 'exitcode', None)})"}
+        hb = getattr(worker, "heartbeat", None)
+        snap = None
+        if hb is not None:
+            try:
+                snap = hb.snapshot()
+            except BaseException:
+                snap = None
+        if snap is None:
+            # no channel (heartbeats disabled / unreachable agent probe):
+            # liveness-only supervision, never a false-positive kill
+            return STATE_OK, {}
+        info = dict(snap)
+        busy = snap.get("busy_s")
+        started = snap.get("started", True)
+        stale_after = (self.wedge_timeout_s if started
+                       else self.boot_grace_s)
+        if snap["beat_age_s"] > stale_after:
+            what = "wedge timeout" if started else "boot grace"
+            info["detail"] = (f"heartbeat stale {snap['beat_age_s']:.2f}s "
+                              f"> {what} {stale_after:.2f}s")
+            return STATE_WEDGED, info
+        if (busy is not None and self.dispatch_deadline_s is not None
+                and busy > self.dispatch_deadline_s):
+            info["detail"] = (f"dispatch busy {busy:.2f}s > deadline "
+                              f"{self.dispatch_deadline_s:.2f}s")
+            return STATE_WEDGED, info
+        if busy is not None and busy > self.slow_after_s:
+            info["detail"] = (f"dispatch busy {busy:.2f}s "
+                              f"(straggler past {self.slow_after_s:.2f}s)")
+            return STATE_SLOW, info
+        return STATE_OK, info
+
+    def _diagnosis(self, worker: Any,
+                   info: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "error": "worker wedged",
+            "rank": worker.rank,
+            "state": STATE_WEDGED,
+            "detail": info.get("detail", "stopped making progress"),
+            "beat_age_s": info.get("beat_age_s"),
+            "busy_s": info.get("busy_s"),
+            "dispatches": info.get("dispatches"),
+            "wedge_timeout_s": self.wedge_timeout_s,
+            "dispatch_deadline_s": self.dispatch_deadline_s,
+        }
+
+    # -- polling ------------------------------------------------------- #
+    def poll_once(self) -> Dict[int, str]:
+        """One classification sweep; reaps newly wedged ranks when
+        ``auto_reap``.  Returns {rank: state}."""
+        new_states: Dict[int, str] = {}
+        to_reap: List[Tuple[Any, Dict[str, Any]]] = []
+        for w in self.workers:
+            state, info = self.classify(w)
+            if state == STATE_WEDGED and self.auto_reap \
+                    and self._states.get(w.rank) != STATE_WEDGED:
+                to_reap.append((w, info))
+            new_states[w.rank] = state
+        for w, info in to_reap:
+            diagnosis = self._diagnosis(w, info)
+            self.reaped.append(diagnosis)
+            log.error("watchdog reaping wedged worker %d: %s", w.rank,
+                      json.dumps(diagnosis, sort_keys=True, default=str))
+            try:
+                w.reap(diagnosis)
+            except BaseException as e:
+                log.warning("reap of worker %d failed: %s", w.rank, e)
+        with self._cond:
+            for rank, state in new_states.items():
+                old = self._states.get(rank)
+                if old != state and self.on_transition is not None:
+                    try:
+                        self.on_transition(rank, old, state)
+                    except BaseException:
+                        pass
+            self._states = new_states
+            self._cond.notify_all()
+        return dict(new_states)
+
+    def states(self) -> Dict[int, str]:
+        with self._cond:
+            return dict(self._states)
+
+    def report(self) -> Dict[str, Any]:
+        """Machine-readable supervision summary (states + reap records)."""
+        return {"states": self.states(), "reaped": list(self.reaped),
+                "wedge_timeout_s": self.wedge_timeout_s,
+                "dispatch_deadline_s": self.dispatch_deadline_s}
+
+    def wait_for(self, predicate: Callable[[Dict[int, str]], bool],
+                 timeout: float) -> bool:
+        """Block until ``predicate(states)`` holds (condition-signaled per
+        poll -- the event-based alternative to sleep-poll test loops)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not predicate(dict(self._states)):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def wait_for_state(self, rank: int, state: str, timeout: float) -> bool:
+        return self.wait_for(lambda s: s.get(rank) == state, timeout)
+
+    # -- lifecycle ----------------------------------------------------- #
+    def start(self) -> "Watchdog":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rla-tpu-watchdog")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except BaseException as e:
+                # supervision must never take the driver down
+                log.warning("watchdog poll failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "Watchdog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def stall_record(exc: BaseException, stage: str) -> Dict[str, Any]:
+    """A machine-readable stall diagnosis mirroring bench.py's
+    death-record shape: flat JSON-able dict with ``error``/``detail``
+    plus ``stall_*`` context keys from the wedge diagnosis."""
+    if isinstance(exc, WorkerWedged):
+        error = "worker wedged"
+    elif isinstance(exc, TimeoutError):
+        error = "attempt deadline exceeded"
+    else:
+        error = "worker died"
+    record: Dict[str, Any] = {
+        "metric": "worker_stall", "value": 0, "unit": "alive",
+        "error": error, "stage": stage,
+        "detail": str(exc)[-500:],
+        "rank": getattr(exc, "rank", None),
+    }
+    for k, v in getattr(exc, "diagnosis", {}).items():
+        record[f"stall_{k}"] = v
+    return record

@@ -1,0 +1,8 @@
+"""Test-support subsystems (deterministic fault injection lives in
+``testing.chaos``).  Import-light: nothing here pulls in jax."""
+
+from .chaos import (CHAOS_ENV, CHAOS_EXIT_CODE, CHAOS_NS_ENV, ChaosFault,
+                    ChaosInjector, parse_chaos)
+
+__all__ = ["CHAOS_ENV", "CHAOS_EXIT_CODE", "CHAOS_NS_ENV", "ChaosFault",
+           "ChaosInjector", "parse_chaos"]

@@ -1,0 +1,183 @@
+"""Deterministic fault injection for the worker runtime.
+
+Hangs, crashes, and stragglers are the failure modes that cost real bench
+rounds (VERDICT.md: wedged tunnel, 25-minute silent hang) -- and the ones
+hardest to reproduce on demand.  This harness makes them deterministic:
+faults are declared in an env var, honored by every ``Worker`` subprocess
+inside its dispatch loop (runtime/actors.py ``_worker_main``), and need no
+TPU, no timing races, no monkeypatching of runtime internals.
+
+Syntax (comma-separated faults)::
+
+    RLA_TPU_CHAOS=crash@rank1:step3,hang@rank0,slow@all:2.5
+
+``kind@target[:qualifier...]`` where
+
+- kind: ``crash`` (``os._exit`` with exit code 43), ``hang`` (freeze the
+  heartbeat, then sleep forever -- simulates a fully frozen process, so
+  the watchdog's stale-beat path fires), ``slow`` (delay the dispatch by
+  the given seconds -- a straggler that still completes);
+- target: ``rankN`` or ``all``;
+- qualifiers: ``stepN`` -- fire on the Nth dispatch of the worker
+  process's lifetime (1-based; crash/hang default to step 1, slow
+  defaults to every dispatch); a float -- the delay for ``slow``;
+  ``once`` -- fire at most once across process RESTARTS (claimed through
+  an atomic token file under the ``RLA_TPU_CHAOS_NS`` directory), so a
+  wedge->restart->resume loop converges deterministically.
+
+Faults fire BEFORE the dispatched fn runs, counting every dispatch
+(including runtime-internal ones such as ``initialize_worker``); tests
+pick explicit steps when that matters.  Parse errors raise driver-side
+(``parse_chaos``) and ship home as a ``RemoteError`` worker-side rather
+than silently dropping the fault.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+CHAOS_ENV = "RLA_TPU_CHAOS"
+CHAOS_NS_ENV = "RLA_TPU_CHAOS_NS"
+CHAOS_EXIT_CODE = 43
+_KINDS = ("crash", "hang", "slow")
+
+
+@dataclass(frozen=True)
+class ChaosFault:
+    kind: str
+    rank: Optional[int]  # None = all ranks
+    step: Optional[int]  # None = every dispatch (slow) / step 1 (crash|hang)
+    delay_s: Optional[float] = None  # slow only
+    once: bool = False
+
+    def matches(self, rank: int, step: int) -> bool:
+        if self.rank is not None and self.rank != rank:
+            return False
+        if self.step is not None:
+            return step == self.step
+        # crash/hang without an explicit step fire on the first dispatch;
+        # slow without one fires on every dispatch
+        return True if self.kind == "slow" else step == 1
+
+    def token(self, rank: int) -> str:
+        """Stable per-rank claim key for ``once`` semantics."""
+        tgt = "all" if self.rank is None else f"rank{self.rank}"
+        step = "any" if self.step is None else f"step{self.step}"
+        return f"{self.kind}-{tgt}-{step}-r{rank}"
+
+
+def parse_chaos(spec: str) -> List[ChaosFault]:
+    """Parse an ``RLA_TPU_CHAOS`` spec; raises ``ValueError`` with the
+    offending token on any malformed fault."""
+    faults: List[ChaosFault] = []
+    for part in (p.strip() for p in spec.split(",")):
+        if not part:
+            continue
+        kind, at, target_q = part.partition("@")
+        if not at or kind not in _KINDS:
+            raise ValueError(
+                f"chaos fault {part!r}: expected kind@target with kind in "
+                f"{_KINDS}")
+        bits = target_q.split(":")
+        target = bits[0]
+        if target == "all":
+            rank = None
+        elif target.startswith("rank") and target[4:].isdigit():
+            rank = int(target[4:])
+        else:
+            raise ValueError(
+                f"chaos fault {part!r}: target must be 'rankN' or 'all', "
+                f"got {target!r}")
+        step: Optional[int] = None
+        delay: Optional[float] = None
+        once = False
+        for q in bits[1:]:
+            if q == "once":
+                once = True
+            elif q.startswith("step") and q[4:].isdigit():
+                step = int(q[4:])
+                if step < 1:
+                    raise ValueError(
+                        f"chaos fault {part!r}: steps are 1-based")
+            else:
+                try:
+                    delay = float(q)
+                except ValueError:
+                    raise ValueError(
+                        f"chaos fault {part!r}: unknown qualifier {q!r} "
+                        "(expected 'stepN', 'once', or a float delay)"
+                    ) from None
+        if kind == "slow" and delay is None:
+            raise ValueError(
+                f"chaos fault {part!r}: 'slow' needs a float delay "
+                "qualifier (e.g. slow@all:2.5)")
+        if kind != "slow" and delay is not None:
+            raise ValueError(
+                f"chaos fault {part!r}: only 'slow' takes a delay")
+        faults.append(ChaosFault(kind, rank, step, delay, once))
+    return faults
+
+
+class ChaosInjector:
+    """Worker-process side: one per worker, consulted once per dispatch.
+
+    ``freeze_heartbeat``: callable stopping the worker's beat thread
+    (``WorkerBeat.freeze``) so a ``hang`` looks like a frozen process to
+    the watchdog, not a long dispatch.
+    """
+
+    def __init__(self, faults: List[ChaosFault], rank: int,
+                 freeze_heartbeat: Optional[Callable[[], None]] = None,
+                 ns_dir: Optional[str] = None):
+        self.faults = faults
+        self.rank = rank
+        self.freeze_heartbeat = freeze_heartbeat
+        self.ns_dir = ns_dir
+        self._step = 0
+        if any(f.once for f in faults) and not ns_dir:
+            raise ValueError(
+                f"chaos 'once' faults need {CHAOS_NS_ENV} set to a "
+                "directory (the cross-restart claim store)")
+
+    @classmethod
+    def from_env(cls, rank: int,
+                 freeze_heartbeat: Optional[Callable[[], None]] = None
+                 ) -> Optional["ChaosInjector"]:
+        spec = os.environ.get(CHAOS_ENV, "")
+        if not spec:
+            return None
+        return cls(parse_chaos(spec), rank, freeze_heartbeat,
+                   os.environ.get(CHAOS_NS_ENV) or None)
+
+    def _claim_once(self, fault: ChaosFault) -> bool:
+        """Atomically claim a once-fault across processes AND restarts:
+        O_CREAT|O_EXCL on a token file -- first claimant fires, every
+        later (re-spawned) process skips."""
+        os.makedirs(self.ns_dir, exist_ok=True)
+        path = os.path.join(self.ns_dir, fault.token(self.rank))
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            return True
+        except FileExistsError:
+            return False
+
+    def on_dispatch(self) -> None:
+        """Called by the dispatch loop before executing the shipped fn."""
+        self._step += 1
+        for fault in self.faults:
+            if not fault.matches(self.rank, self._step):
+                continue
+            if fault.once and not self._claim_once(fault):
+                continue
+            if fault.kind == "slow":
+                time.sleep(fault.delay_s)
+            elif fault.kind == "crash":
+                os._exit(CHAOS_EXIT_CODE)
+            elif fault.kind == "hang":
+                if self.freeze_heartbeat is not None:
+                    self.freeze_heartbeat()
+                while True:  # wedged until the watchdog reaps us
+                    time.sleep(3600)

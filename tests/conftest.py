@@ -22,7 +22,10 @@ import jax  # noqa: E402
 _platform = os.environ.get("RLA_TPU_TEST_PLATFORM", "cpu")
 jax.config.update("jax_platforms", _platform)
 if _platform == "cpu":
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # pre-0.5 jax: the XLA_FLAGS device-count override above applies
 
 # RLA_TPU_WORKER_PLATFORM is scoped to the one test that gates on it
 # (test_tpu_world.py re-sets it from the stash inside the test): left
@@ -30,3 +33,23 @@ if _platform == "cpu":
 # -- with a real chip, two CPU-gloo tests' workers would contend for the
 # single device claim and deadlock.
 WORKER_PLATFORM_STASH = os.environ.pop("RLA_TPU_WORKER_PLATFORM", None)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _chaos_leak_guard(request):
+    """``RLA_TPU_CHAOS`` makes every spawned worker crash/hang/stall on
+    purpose: ambient in the driver env it would poison EVERY fan-out in
+    the suite.  Only ``@pytest.mark.chaos`` tests may see it set, and no
+    test may leave it behind."""
+    is_chaos = request.node.get_closest_marker("chaos") is not None
+    if not is_chaos:
+        assert "RLA_TPU_CHAOS" not in os.environ, (
+            f"RLA_TPU_CHAOS leaked into non-chaos test {request.node.nodeid}"
+            " -- chaos specs belong in env_per_worker or a chaos-marked "
+            "test's monkeypatched env")
+    yield
+    assert "RLA_TPU_CHAOS" not in os.environ, (
+        f"{request.node.nodeid} left RLA_TPU_CHAOS set in the driver env; "
+        "later fan-outs would inherit the fault injection")

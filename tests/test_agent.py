@@ -770,3 +770,58 @@ def test_queue_bind_for_agents_stays_loopback_for_local_agents():
         is None
     assert queue_bind_for_agents(["127.0.0.1:7777", "10.0.0.5:7777"]) \
         == "0.0.0.0"
+
+
+def _hang_remote():
+    import time
+    time.sleep(10_000)
+
+
+def test_remote_worker_heartbeat_and_wedge_reap(two_agents):
+    """Watchdog parity over the wire: heartbeat snapshots are taken
+    agent-side (only ages cross the network), a wedged remote rank is
+    reaped through the agent, and its future fails with the TYPED
+    WorkerWedged -- diagnosis intact -- after crossing the relay as
+    (name, message, tb)."""
+    from ray_lightning_accelerators_tpu.runtime.watchdog import (Watchdog,
+                                                                 WorkerWedged)
+    w = RemoteWorker(two_agents[0], rank=0,
+                     env={"RLA_TPU_WORKER_HEARTBEAT_S": "0.05"})
+    wd = None
+    try:
+        assert w.execute(_sq, 3).result(timeout=60) == 9
+        snap = w.heartbeat.snapshot()
+        assert snap is not None
+        assert snap["started"]
+        assert snap["dispatches"] == 1
+        fut = w.execute(_hang_remote)
+        wd = Watchdog([w], wedge_timeout_s=30.0, dispatch_deadline_s=0.4,
+                      poll_s=0.05).start()
+        with pytest.raises(WorkerWedged) as ei:
+            fut.result(timeout=120)
+        assert ei.value.rank == 0
+        assert "deadline" in ei.value.diagnosis["detail"]
+        # the slot stays restartable through the same agent connection
+        w.restart()
+        assert w.execute(_sq, 4).result(timeout=60) == 16
+    finally:
+        if wd is not None:
+            wd.stop()
+        w.kill()
+
+
+def test_is_loopback_classification():
+    """Round-5 advisor fix: the RCE gate must not be foolable by the old
+    startswith('127.') prefix check, and IPv6 loopback must count."""
+    from ray_lightning_accelerators_tpu.runtime.agent import is_loopback
+    assert is_loopback("127.0.0.1")
+    assert is_loopback("127.9.9.9")
+    assert is_loopback("localhost")
+    assert is_loopback("::1")
+    assert is_loopback("[::1]")
+    assert not is_loopback("10.0.0.5")
+    assert not is_loopback("::2")
+    assert not is_loopback("0.0.0.0")
+    # a '127.'-PREFIXED hostname is not an address: it must resolve (and
+    # be loopback) or be refused -- unresolvable fails closed
+    assert not is_loopback("127.evil.example.invalid")

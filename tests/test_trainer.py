@@ -242,3 +242,65 @@ def test_wrap_pad_batch_contract():
     scalar = {"x": np.ones((10, 3)), "n": np.float32(3.0)}
     out, true_n, padded_n = trainer._wrap_pad_batch(scalar)
     assert true_n is None and out is scalar
+
+
+def test_predict_pad_strip_requires_consistent_output_axis(tmp_path):
+    """Round-5 advisor fix: the pad-strip must slice outputs ONLY when
+    every leaf shares the padded per-sample axis.  A leaf whose leading
+    dim merely coincides with the padded size (per-head stats) must not
+    be silently truncated -- mixed outputs come back unsliced with a
+    warning instead."""
+    import jax.numpy as jnp
+
+    from ray_lightning_accelerators_tpu import ArrayDataset, DataLoader
+    from tests.utils import BoringModel, boring_loaders
+
+    class PerSampleOnly(BoringModel):
+        def predict_step(self, params, batch):
+            return {"y": self.forward(params, batch)}
+
+    class ScalarPlus(BoringModel):
+        def predict_step(self, params, batch):
+            # a scalar leaf has no leading axis to mis-truncate: it must
+            # not veto the strip of the per-sample leaves
+            return {"y": self.forward(params, batch),
+                    "temp": jnp.float32(0.7)}
+
+    class MixedOutputs(BoringModel):
+        def predict_step(self, params, batch):
+            # "stats" leading dim (4) is NOT the per-sample axis
+            return {"y": self.forward(params, batch),
+                    "stats": jnp.ones((4, 2))}
+
+    train, val = boring_loaders()
+    x = np.random.default_rng(0).normal(size=(10, 32)).astype("float32")
+    loader = DataLoader(ArrayDataset(x), batch_size=10)
+
+    model = PerSampleOnly()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmp_path / "a"))
+    trainer.fit(model, train, val)
+    preds = trainer.predict(model, loader)
+    # 10 rows pad to the 8-device divisor (16); consistent outputs are
+    # sliced back to the true count
+    assert np.asarray(preds[0]["y"]).shape[0] == 10
+
+    model = ScalarPlus()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmp_path / "s"))
+    trainer.fit(model, train, val)
+    preds = trainer.predict(model, loader)
+    assert np.asarray(preds[0]["y"]).shape[0] == 10  # still stripped
+    assert np.ndim(preds[0]["temp"]) == 0            # scalar untouched
+
+    model = MixedOutputs()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmp_path / "b"))
+    trainer.fit(model, train, val)
+    preds = trainer.predict(model, loader)
+    # mixed leading dims: nothing is sliced (warn-and-skip), padding kept
+    assert np.asarray(preds[0]["y"]).shape[0] == 16
+    assert np.asarray(preds[0]["stats"]).shape == (4, 2)
