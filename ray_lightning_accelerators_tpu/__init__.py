@@ -20,6 +20,8 @@ from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.session import get_actor_rank, init_session, put_queue
 from .utils.profiler import Profiler, device_memory_stats
 from . import models  # lazy family exports (models/__init__.py PEP 562)
+from . import serve
+from .serve import ServeEngine, ServeReplicas
 from . import tune
 from .tune import TuneReportCallback, TuneReportCheckpointCallback
 from .utils import schedules
@@ -37,5 +39,6 @@ __all__ = [
     "get_actor_rank", "init_session", "put_queue",
     "Profiler", "device_memory_stats",
     "models", "schedules",
+    "serve", "ServeEngine", "ServeReplicas",
     "tune", "TuneReportCallback", "TuneReportCheckpointCallback",
 ]

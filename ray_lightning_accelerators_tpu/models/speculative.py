@@ -20,6 +20,12 @@ Mechanics worth noting:
 - Greedy only (exactness is the contract); batch size 1 (acceptance
   length varies per row); rolling-window caches unsupported (the chunk
   path needs linear slots).
+- **Serving**: because greedy speculative decode obeys the same
+  exactness contract as `serve.ServeEngine` (token-identical to target
+  greedy `generate()`), the serve engine may route single-stream
+  (batch-1) requests through this path — e.g. a latency-sensitive lane
+  with a draft model — and batch everything else; clients cannot tell
+  which path produced a response.
 """
 
 from __future__ import annotations
@@ -45,8 +51,15 @@ def speculative_generate(target: GPT, target_params,
     number of tokens drafted per round.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
-    if prompt.shape[0] != 1:
-        raise ValueError("speculative decoding supports batch size 1")
+    # explicit single-stream shape contract (not an implicit assumption):
+    # acceptance length varies per row, so rows cannot share a chunk pass
+    if prompt.ndim != 2 or prompt.shape[0] != 1:
+        raise ValueError(
+            "speculative decoding is single-stream: expected a prompt "
+            f"shaped [1, prompt_len], got {tuple(prompt.shape)} -- batch "
+            "requests belong in serve.ServeEngine's continuous-batching "
+            "slots; only batch-1 streams may route through speculative "
+            "decode")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if target.cfg.sliding_window is not None or \

@@ -115,6 +115,24 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _rope_rows(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings with PER-ROW positions.  x: [b, h, 1, d],
+    positions: [b] — the continuous-batching decode step, where every
+    batch row sits at its own sequence position.  Element-for-element the
+    same arithmetic as `_rope`, so a row at position p matches the
+    shared-position decode path exactly."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = (positions[:, None].astype(jnp.float32)
+              * freqs[None, :])                       # [b, d/2]
+    cos = jnp.cos(angles)[:, None, None, :]
+    sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
 def _remat_policy(name: str):
     """Map a config string to a jax.checkpoint policy."""
     policies = {
@@ -698,13 +716,21 @@ class GPT(TpuModule):
     # position.  No reference analog (predict there is plain model(x),
     # reference: ray_lightning/tests/utils.py:137-152).
 
-    def _prefill(self, params, tokens, cache_len):
+    def _prefill(self, params, tokens, cache_len, last_index=None):
         """Run the prompt once; returns (last-position hidden [B,d],
         cache dict with k/v [L,B,H,cache_len,D]).
 
         ``cache_len < prompt_len`` (the sliding-window rolling cache) keeps
         only the last ``cache_len`` positions, scattered to their ring
-        slots ``p % cache_len``."""
+        slots ``p % cache_len``.
+
+        ``last_index`` ([B] or scalar int32): return the hidden state at
+        that position instead of the final one — the serve engine right-
+        pads prompts into fixed length buckets (bounded compile count) and
+        needs the hidden at the TRUE last prompt token.  Pad positions
+        write garbage k/v beyond ``last_index``, which is safe for linear
+        decode: slot p is rewritten by the decode step at position p
+        before any mask ever lets it be attended."""
         dt = self.compute_dtype
         h = self._embed_lookup(params, tokens)
         pos = jnp.arange(tokens.shape[1])
@@ -730,9 +756,13 @@ class GPT(TpuModule):
                 "v": zk.at[:, :, :, slots, :].set(vs[:, :, :, -cache_len:]),
             }
         h = self._rms_norm(h, params["ln_f"])
-        return h[:, -1], cache
+        if last_index is None:
+            return h[:, -1], cache
+        idx = jnp.asarray(last_index, jnp.int32)
+        return h[jnp.arange(h.shape[0]), idx], cache
 
-    def _decode_attn_block(self, h, lp, ck, cv, pos0, ring: bool):
+    def _decode_attn_block(self, h, lp, ck, cv, pos0, ring: bool,
+                           row_positions=None):
         """One layer, n cached-decode tokens at positions pos0..pos0+n-1.
         h: [B,n,d]; ck/cv: [B,H,W,D].
 
@@ -740,26 +770,41 @@ class GPT(TpuModule):
         buffer over slots ``p % W`` with wrap-around validity — W == max
         length degenerates to the plain linear cache.  ``ring=False``
         (speculative chunk scoring): linear slots, causal within the
-        chunk and over the prefix.  One implementation so the two decode
-        paths cannot drift apart (speculative exactness depends on it).
+        chunk and over the prefix.  ``row_positions`` ([B] int32, n==1,
+        ring must be False): continuous-batching serve step — every batch
+        row decodes at its OWN position into linear slots.  One
+        implementation so the three decode paths cannot drift apart
+        (speculative and serve exactness both depend on it).
         """
         cfg = self.cfg
         dt = self.compute_dtype
         a = lp["attn"]
         n = h.shape[1]
         x = self._rms_norm(h, lp["ln1"])
-        positions = pos0 + jnp.arange(n)
         q = self._qkv_proj_decode(x, a["wq"], dt)
         k = self._qkv_proj_decode(x, a["wk"], dt)
         v = self._qkv_proj_decode(x, a["wv"], dt)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
         W = ck.shape[2]
-        slot = jax.lax.rem(pos0, W) if ring else pos0
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, 0, slot, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, 0, slot, 0))
+        if row_positions is not None:
+            q = _rope_rows(q, row_positions, cfg.rope_theta)
+            k = _rope_rows(k, row_positions, cfg.rope_theta)
+
+            # per-row slot write: row b's k/v land at ITS position (a
+            # batched scatter; joining/retiring is never a recompile)
+            def upd(c, kk, p):
+                return jax.lax.dynamic_update_slice(c, kk, (0, p, 0))
+
+            ck = jax.vmap(upd)(ck, k.astype(ck.dtype), row_positions)
+            cv = jax.vmap(upd)(cv, v.astype(cv.dtype), row_positions)
+        else:
+            positions = pos0 + jnp.arange(n)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            slot = jax.lax.rem(pos0, W) if ring else pos0
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, 0, slot, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, 0, slot, 0))
         # grouped query attention over the (unrepeated) KV cache; groups=1
         # is plain MHA
         b = q.shape[0]
@@ -769,7 +814,10 @@ class GPT(TpuModule):
         s = jnp.einsum("bkgqd,bktd->bkgqt", qg, ck.astype(jnp.float32)
                        ) * cfg.head_dim ** -0.5
         t = jnp.arange(W)[None, None, None, None]
-        rows = positions[None, None, None, :, None]
+        if row_positions is not None:
+            rows = row_positions[:, None, None, None, None]
+        else:
+            rows = positions[None, None, None, :, None]
         if ring:
             # once a row's position >= W every slot holds a position in
             # (pos-W, pos] — exactly the attention span (the cache is
@@ -828,6 +876,66 @@ class GPT(TpuModule):
             lp, ck, cv = xs
             h_out, ck2, cv2 = self._decode_attn_block(h_in, lp, ck, cv,
                                                       pos, ring=True)
+            return h_out, (ck2, cv2)
+
+        h, (cks, cvs) = jax.lax.scan(
+            layer, h, (params["layers"], cache["k"], cache["v"]))
+        h = self._rms_norm(h, params["ln_f"])
+        logits = self._unembed_matmul(h[:, 0], params, dt)
+        return logits, {"k": cks, "v": cvs}
+
+    # ------------------------------------------------------------------ #
+    # Continuous-batching decode (serve engine primitives)               #
+    # ------------------------------------------------------------------ #
+    # The cache is allocated [L, B, H, total_len, D] up front, so joining
+    # a sequence mid-flight is a slot scatter and retiring one is a
+    # host-side slot free -- never a reshape, never a recompile.  Rows
+    # advance at PER-ROW positions (each slot is its own request).
+
+    def decode_cache_alloc(self, batch: int, total_len: int):
+        """Zeroed multi-slot KV cache [L, batch, kv_heads, total_len,
+        head_dim] in the compute dtype — the serve engine's fixed decode
+        slots."""
+        cfg = self.cfg
+        shape = (cfg.n_layers, batch, cfg.kv_heads, total_len,
+                 cfg.head_dim)
+        return {"k": jnp.zeros(shape, self.compute_dtype),
+                "v": jnp.zeros(shape, self.compute_dtype)}
+
+    @staticmethod
+    def cache_join(cache, row_cache, slot):
+        """Scatter a single-request cache [L,1,H,P,D] into row ``slot`` of
+        a multi-slot cache [L,B,H,W,D] (P <= W).  ``slot`` may be traced:
+        a join is one dynamic_update_slice per k/v, so admitting a request
+        never retraces.  Stale garbage past P in the target row is safe —
+        linear decode rewrites slot p at position p before the causal mask
+        ever exposes it."""
+
+        def put(big, row):
+            return jax.lax.dynamic_update_slice(
+                big, row.astype(big.dtype), (0, slot, 0, 0, 0))
+
+        return {"k": put(cache["k"], row_cache["k"]),
+                "v": put(cache["v"], row_cache["v"])}
+
+    def decode_step_rows(self, params, cache, tokens, positions):
+        """Full-depth single-token step for EVERY cache row at once, each
+        row at its own position (the continuous-batching primitive).
+        tokens: [B] int32 (the token each row feeds); positions: [B]
+        int32 (that token's sequence position).  Linear slots only — no
+        sliding-window ring.  Rows the caller considers inactive may feed
+        any token at any in-range position: their slot is fully rewritten
+        by the next join before it is attended.  Returns (logits [B,V]
+        f32, updated cache)."""
+        dt = self.compute_dtype
+        positions = jnp.asarray(positions, jnp.int32)
+        h = self._embed_lookup(params, tokens)[:, None]  # [B,1,d]
+
+        def layer(carry, xs):
+            lp, ck, cv = xs
+            h_out, ck2, cv2 = self._decode_attn_block(
+                carry, lp, ck, cv, 0, ring=False,
+                row_positions=positions)
             return h_out, (ck2, cv2)
 
         h, (cks, cvs) = jax.lax.scan(

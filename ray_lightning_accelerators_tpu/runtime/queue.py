@@ -18,9 +18,15 @@ from __future__ import annotations
 
 import os
 import queue as queue_mod
+import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional, Tuple
+
+
+class QueueShutdown(RuntimeError):
+    """Typed rejection for ``put()`` on a shut-down queue: the item would
+    never be drained or executed, so silently accepting it loses work."""
 
 
 class TrampolineQueue:
@@ -28,9 +34,20 @@ class TrampolineQueue:
 
     def __init__(self, backend: Optional[Any] = None):
         self._q = backend if backend is not None else queue_mod.Queue()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def put(self, item: Tuple[int, Callable[[], Any]]) -> None:
-        self._q.put(item)
+        with self._lock:
+            if self._closed:
+                raise QueueShutdown(
+                    "TrampolineQueue is shut down; the item would never "
+                    "be drained")
+            self._q.put(item)
 
     def get_nowait(self):
         try:
@@ -41,8 +58,24 @@ class TrampolineQueue:
     def empty(self) -> bool:
         return self._q.empty()
 
-    def shutdown(self) -> None:
-        pass
+    def shutdown(self) -> List[Any]:
+        """Idempotent close.  Marks the queue closed (later ``put``s raise
+        ``QueueShutdown``) and drains anything still enqueued WITHOUT
+        executing it, returning the drained items so the caller can cancel
+        them in a typed way (the serve engine fails each drained request
+        with ``ServeCancelled``; executing driver thunks mid-teardown
+        would race the state they close over).  Second and later calls
+        are no-ops returning []."""
+        with self._lock:
+            first, self._closed = not self._closed, True
+        drained: List[Any] = []
+        if first:
+            while True:
+                item = self.get_nowait()
+                if item is None:
+                    break
+                drained.append(item)
+        return drained
 
 
 class QueueServer:

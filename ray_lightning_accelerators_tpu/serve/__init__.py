@@ -1,0 +1,34 @@
+"""Serve subsystem: continuous-batching inference over the actor runtime.
+
+The training stack already owned every ingredient a server needs — a
+static-shaped KV-cache decode loop (models/transformer.py), watchdog-
+supervised workers (runtime/watchdog.py), and reservoir-percentile
+profiling (utils/profiler.py).  This package composes them into a
+request-serving engine:
+
+- **batcher**: bounded admission with typed backpressure (``QueueFull``,
+  ``RequestRejected``, ``ServeCancelled``);
+- **engine**: the continuous-batching driver loop — fixed decode slots
+  over an up-front [L, B, H, total_len, D] cache, so joining/retiring
+  sequences mid-flight is a slot write, never a recompile;
+- **metrics**: throughput, queue depth, TTFT and per-token latency at
+  p50/p95/p99/max via the profiler's reservoir percentiles;
+- **replicas**: N engine replicas on the existing ``ActorPool`` with
+  watchdog supervision — a wedged replica is reaped and its in-flight
+  requests re-queued onto survivors, never lost or duplicated.
+
+Exactness is the contract: every response is token-identical to a
+standalone greedy ``GPT.generate()`` of the same prompt.
+"""
+
+from .batcher import (AdmissionController, QueueFull, RequestRejected,
+                      ServeCancelled, ServeRequest, ServeResponse)
+from .engine import ServeEngine
+from .metrics import ServeMetrics
+from .replicas import ServeReplicas
+
+__all__ = [
+    "AdmissionController", "QueueFull", "RequestRejected",
+    "ServeCancelled", "ServeRequest", "ServeResponse",
+    "ServeEngine", "ServeMetrics", "ServeReplicas",
+]

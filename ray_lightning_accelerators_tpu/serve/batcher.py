@@ -1,0 +1,236 @@
+"""Admission control and backpressure for the serve engine.
+
+A serving system's failure surface must be TYPED: a client that gets a
+generic exception cannot tell "shed load and retry later" (``QueueFull``)
+from "this request can never be served" (``RequestRejected``) from "the
+engine is going away" (``ServeCancelled``).  The admission queue is
+bounded — unbounded queues turn overload into unbounded tail latency and
+OOM instead of fast rejection.
+
+Storage reuses the runtime's ``TrampolineQueue`` so shutdown rides its
+idempotent drain path (runtime/queue.py): ``shutdown()`` drains whatever
+is still enqueued and fails each request with ``ServeCancelled`` instead
+of executing or silently dropping it.  A requeue lane sits IN FRONT of
+the main queue for requests that already cost prefill work on a replica
+that wedged — they re-enter at the head, bypass the depth check (they
+were admitted once; bouncing them on a full queue would turn an infra
+failure into a client-visible loss), and carry a requeue count so retry
+loops are bounded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.queue import TrampolineQueue
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — backpressure.  Retryable: the caller
+    sheds load (the HTTP 429 analog)."""
+
+    def __init__(self, depth: int, limit: int):
+        super().__init__(
+            f"serve queue full: {depth} queued >= depth cap {limit}; "
+            "retry after responses drain")
+        self.depth = depth
+        self.limit = limit
+
+
+class RequestRejected(ValueError):
+    """The request can never be served by this engine (empty prompt, non
+    positive budget, prompt + budget past the cache length).  Not
+    retryable as-is: the client must change the request."""
+
+
+class ServeCancelled(RuntimeError):
+    """Typed cancellation: the engine shut down (or lost every replica)
+    with the request still queued or in flight.  The request was NOT
+    served; re-submission to a live engine is safe."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One admitted generation request."""
+
+    request_id: int
+    prompt: np.ndarray          # [prompt_len] int32
+    max_new_tokens: int
+    t_submit: float             # monotonic, stamped at admission
+    requeues: int = 0           # infra-failure re-admissions so far
+
+
+class ServeResponse:
+    """Caller-side handle for a submitted request.
+
+    ``result(timeout)`` blocks for the full token sequence
+    (prompt + generated, [total] int32 numpy) or raises the typed
+    failure.  ``ttft_s`` is filled when the first token is produced.
+    Completion is exactly-once: the first ``_complete``/``_fail`` wins,
+    later ones report False — the replicas layer relies on this to
+    guarantee a re-queued request is never answered twice."""
+
+    def __init__(self, request: ServeRequest):
+        self.request = request
+        self.ttft_s: Optional[float] = None
+        self._fut: Future = Future()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self._fut.result(timeout)
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def exception(self, timeout: Optional[float] = None):
+        return self._fut.exception(timeout)
+
+    # -- engine side ---------------------------------------------------- #
+    def _complete(self, tokens: np.ndarray) -> bool:
+        if self._fut.done():
+            return False
+        self._fut.set_result(tokens)
+        return True
+
+    def _fail(self, exc: BaseException) -> bool:
+        if self._fut.done():
+            return False
+        self._fut.set_exception(exc)
+        return True
+
+
+class AdmissionController:
+    """Bounded, typed admission in front of an engine (or replica group).
+
+    ``queue_depth``: cap on requests queued but not yet decoding — the
+    backpressure knob.  ``max_total_len``: per-request budget check
+    (prompt + max_new_tokens must fit the decode cache).
+    ``max_new_tokens_cap``: optional per-request generation budget cap.
+    """
+
+    def __init__(self, queue_depth: int = 64,
+                 max_total_len: Optional[int] = None,
+                 max_new_tokens_cap: Optional[int] = None):
+        if queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self.queue_depth = queue_depth
+        self.max_total_len = max_total_len
+        self.max_new_tokens_cap = max_new_tokens_cap
+        self._q = TrampolineQueue()
+        self._requeue: deque = deque()
+        self._cond = threading.Condition()
+        self._depth = 0
+        self._closed = False
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def submit(self, prompt: Any, max_new_tokens: int) -> ServeResponse:
+        """Admit a request or raise typed: ``RequestRejected`` (can never
+        be served), ``QueueFull`` (backpressure), ``ServeCancelled``
+        (controller shut down)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise RequestRejected("empty prompt")
+        if max_new_tokens < 1:
+            raise RequestRejected(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if self.max_new_tokens_cap is not None \
+                and max_new_tokens > self.max_new_tokens_cap:
+            raise RequestRejected(
+                f"max_new_tokens {max_new_tokens} exceeds the engine cap "
+                f"{self.max_new_tokens_cap}")
+        if self.max_total_len is not None \
+                and prompt.size + max_new_tokens > self.max_total_len:
+            raise RequestRejected(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the decode budget "
+                f"{self.max_total_len}")
+        with self._cond:
+            if self._closed:
+                raise ServeCancelled("serve queue is shut down")
+            if self._depth >= self.queue_depth:
+                raise QueueFull(self._depth, self.queue_depth)
+            req = ServeRequest(next(self._ids), prompt,
+                               int(max_new_tokens), time.monotonic())
+            resp = ServeResponse(req)
+            self._q.put((req, resp))
+            self._depth += 1
+            self._cond.notify_all()
+        return resp
+
+    def requeue(self, req: ServeRequest, resp: ServeResponse) -> bool:
+        """Head-of-line re-admission after an infra failure (replica
+        wedged/died mid-chunk).  Bypasses the depth cap — the request was
+        already admitted once.  Returns False (and fails the response
+        typed) when the controller is already closed."""
+        with self._cond:
+            if not self._closed:
+                req.requeues += 1
+                self._requeue.append((req, resp))
+                self._depth += 1
+                self._cond.notify_all()
+                return True
+        resp._fail(ServeCancelled(
+            f"request {req.request_id} cancelled: engine shut down while "
+            "it awaited re-dispatch"))
+        return False
+
+    def pop(self) -> Optional[Tuple[ServeRequest, ServeResponse]]:
+        """Next request or None.  The requeue lane drains first."""
+        with self._cond:
+            if self._requeue:
+                self._depth -= 1
+                return self._requeue.popleft()
+            item = self._q.get_nowait()
+            if item is not None:
+                self._depth -= 1
+            return item
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block up to ``timeout`` for queued work (or closure); True when
+        work is available.  Event-driven idle — the engine loop must not
+        spin."""
+        with self._cond:
+            if self._depth == 0 and not self._closed:
+                self._cond.wait(timeout)
+            return self._depth > 0
+
+    def kick(self) -> None:
+        """Wake anything blocked in ``wait_for_work`` (engine stop path)."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def shutdown(self) -> int:
+        """Idempotent: close admission and cancel everything still queued
+        with ``ServeCancelled`` (riding ``TrampolineQueue.shutdown``'s
+        drain).  Returns the number of cancelled requests."""
+        with self._cond:
+            self._closed = True
+            drained: List[Tuple[ServeRequest, ServeResponse]] = \
+                list(self._q.shutdown())
+            drained.extend(self._requeue)
+            self._requeue.clear()
+            self._depth = 0
+            self._cond.notify_all()
+        n = 0
+        for req, resp in drained:
+            if resp._fail(ServeCancelled(
+                    f"request {req.request_id} cancelled: engine shut "
+                    "down with it still queued")):
+                n += 1
+        return n

@@ -1,0 +1,322 @@
+"""Continuous-batching serve engine over the static-shaped decode loop.
+
+The decode cache is allocated ``[L, max_slots, H, max_total_len, D]`` up
+front, so the engine's whole lifecycle is THREE compiled programs, all
+static-shaped, none ever retraced per request:
+
+- **prefill** (one per prompt-length bucket): run a right-padded prompt,
+  return the first greedy token and a single-row cache;
+- **join**: dynamic_update_slice the row cache into a free slot (slot
+  index is traced — admitting never recompiles);
+- **step**: one ``decode_step_rows`` over ALL slots at per-row positions,
+  argmax per row.
+
+Joining and retiring sequences mid-flight is therefore a slot write and a
+host-side slot free — the veScale-style per-replica eager model: one
+process, one fixed mesh (decode runs replicated, like ``generate()``),
+requests streaming through fixed-shape programs.
+
+**Exactness contract**: greedy only; every response is token-identical to
+a standalone ``GPT.generate(prompt, max_new_tokens)`` of that prompt.
+This holds because prefill/step reuse the same ``_decode_attn_block``
+arithmetic, pad positions are causally masked (prefill) or rewritten
+before the mask exposes them (decode), and softmax over the wider shared
+cache adds only exactly-zero terms.  The CPU test suite asserts it
+token-for-token.
+
+Single-stream note: a batch-1 request could equally be routed through
+``models.speculative.speculative_generate`` (its linear-cache chunk
+scoring is join-compatible); the engine keeps greedy slots for
+simplicity, but the speculative path enforces the same exactness
+contract, so a router may mix them per request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log
+from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
+                      ServeResponse)
+from .metrics import ServeMetrics
+
+
+class _Slot:
+    """Host-side state of one active decode slot."""
+
+    __slots__ = ("req", "resp", "pos", "last", "generated", "remaining",
+                 "t_last")
+
+    def __init__(self, req: ServeRequest, resp: ServeResponse, pos: int,
+                 first_token: int, t_now: float):
+        self.req = req
+        self.resp = resp
+        self.pos = pos                    # position of the token to feed
+        self.last = first_token           # token to feed next step
+        self.generated = [first_token]
+        self.remaining = req.max_new_tokens - 1
+        self.t_last = t_now               # per-token latency anchor
+
+
+class ServeEngine:
+    """Continuous-batching greedy inference over one model replica.
+
+    ``max_slots``: fixed decode batch (the cache's B).  ``queue_depth``:
+    admission cap beyond the slots (backpressure).  ``max_total_len``:
+    per-slot cache length; prompt + max_new_tokens of every request must
+    fit (defaults to the model's max_seq_len).  ``prompt_block``: prompts
+    are right-padded to multiples of this, bounding prefill compile count
+    without unbounded padding waste.
+    """
+
+    def __init__(self, model: Any, params: Any, *, max_slots: int = 4,
+                 queue_depth: int = 64,
+                 max_total_len: Optional[int] = None,
+                 max_new_tokens_cap: Optional[int] = None,
+                 prompt_block: int = 8,
+                 metrics: Optional[ServeMetrics] = None,
+                 idle_poll_s: float = 0.05):
+        import jax
+
+        if model.cfg.sliding_window is not None:
+            raise ValueError(
+                "the serve engine needs linear cache slots; "
+                "sliding_window models are unsupported (their rolling "
+                "ring cache cannot slot-join)")
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        W = (max_total_len if max_total_len is not None
+             else model.cfg.max_seq_len)
+        if W > model.cfg.max_seq_len:
+            raise ValueError(
+                f"max_total_len {W} exceeds the model's max_seq_len "
+                f"{model.cfg.max_seq_len}")
+        self.model = model
+        # decode replicated, exactly like generate(): a training-time mesh
+        # must not carve up step-sized activations
+        self._mesh_saved, model.mesh = model.mesh, None
+        self.params = jax.tree.map(jax.numpy.asarray, params)
+        self.max_slots = max_slots
+        self.max_total_len = W
+        self.prompt_block = max(1, prompt_block)
+        self.metrics = metrics or ServeMetrics()
+        self.batcher = AdmissionController(
+            queue_depth=queue_depth, max_total_len=W,
+            max_new_tokens_cap=max_new_tokens_cap)
+        self.metrics.bind_queue(lambda: self.batcher.depth)
+        self._idle_poll_s = idle_poll_s
+        self._jax = jax
+        # donate the cache operand where donation is real (TPU/GPU): the
+        # hot loop reassigns self._cache every call, so without donation
+        # each step/join copies the whole [L,B,H,W,D] pair and doubles
+        # peak cache memory.  CPU ignores donation with a warning per
+        # call site -- skip it there to keep test logs quiet.
+        donate = jax.default_backend() != "cpu"
+        self._join = jax.jit(type(model).cache_join,
+                             donate_argnums=(0,) if donate else ())
+        self._step = jax.jit(
+            lambda p, c, t, pos: model.decode_step_rows(p, c, t, pos),
+            donate_argnums=(1,) if donate else ())
+        self._prefills: Dict[int, Any] = {}
+        self._cache = None
+        self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._stop = threading.Event()
+        self._cancel_active = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle                                                          #
+    # ------------------------------------------------------------------ #
+    def start(self) -> "ServeEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._cache = self.model.decode_cache_alloc(self.max_slots,
+                                                    self.max_total_len)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rla-tpu-serve-engine")
+        self._thread.start()
+        return self
+
+    def stop(self, cancel_active: bool = False,
+             timeout: float = 60.0) -> None:
+        """Stop admitting; by default FINISH the in-flight slots (their
+        budgets bound the wait), cancel everything still queued with
+        ``ServeCancelled``, then join the loop.  ``cancel_active=True``
+        cancels in-flight requests too (fast teardown)."""
+        self._cancel_active = cancel_active
+        self._stop.set()
+        self.batcher.kick()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        n = self.batcher.shutdown()
+        if n:
+            self.metrics.inc("cancelled", n)
+        self.model.mesh = self._mesh_saved
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------ #
+    # Client surface                                                     #
+    # ------------------------------------------------------------------ #
+    def submit(self, prompt: Any, max_new_tokens: int) -> ServeResponse:
+        """Admit a request (typed QueueFull/RequestRejected backpressure);
+        the response resolves to prompt + greedily generated tokens,
+        token-identical to ``generate()``."""
+        from .batcher import QueueFull, RequestRejected
+        try:
+            resp = self.batcher.submit(prompt, max_new_tokens)
+        except (QueueFull, RequestRejected):
+            # admission rejections only: a ServeCancelled from a stopping
+            # engine must not read as overload in the counters
+            self.metrics.inc("rejected")
+            raise
+        self.metrics.inc("submitted")
+        return resp
+
+    def stats(self) -> Dict[str, Any]:
+        return self.metrics.snapshot()
+
+    # ------------------------------------------------------------------ #
+    # Driver loop                                                        #
+    # ------------------------------------------------------------------ #
+    def _run(self) -> None:
+        try:
+            while True:
+                if not self._stop.is_set():
+                    self._admit()
+                active = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+                if active:
+                    if self._stop.is_set() and self._cancel_active:
+                        self._cancel_slots()
+                        continue
+                    self._decode_step(active)
+                elif self._stop.is_set():
+                    return
+                else:
+                    self.batcher.wait_for_work(self._idle_poll_s)
+        except BaseException as e:  # engine death must fail loudly, typed
+            log.error("serve engine loop died: %s", e)
+            for i, s in enumerate(self._slots):
+                if s is not None and s.resp._fail(e):
+                    self.metrics.inc("failed")
+                self._slots[i] = None
+            n = self.batcher.shutdown()
+            if n:  # keep completed+failed+cancelled == submitted honest
+                self.metrics.inc("cancelled", n)
+            raise
+
+    def _bucket(self, s0: int) -> int:
+        b = self.prompt_block
+        return min(-(-s0 // b) * b, self.max_total_len)
+
+    def _prefill_fn(self, padded_len: int):
+        if padded_len not in self._prefills:
+            jax, model = self._jax, self.model
+            jnp = jax.numpy
+
+            def fn(params, tokens, last_index):
+                h_last, cache = model._prefill(params, tokens, padded_len,
+                                               last_index=last_index)
+                logits = model._unembed_matmul(h_last, params,
+                                               model.compute_dtype)
+                return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+            self._prefills[padded_len] = jax.jit(fn)
+        return self._prefills[padded_len]
+
+    def _admit(self) -> int:
+        """Fill free slots from the queue: pad-prefill each request, slot-
+        join its cache, record TTFT (the first token exists the moment
+        prefill returns)."""
+        jnp = self._jax.numpy
+        admitted = 0
+        for i in range(self.max_slots):
+            if self._slots[i] is not None:
+                continue
+            item = self.batcher.pop()
+            if item is None:
+                break
+            req, resp = item
+            t_a = time.monotonic()
+            s0 = int(req.prompt.size)
+            P = self._bucket(s0)
+            padded = np.zeros((1, P), np.int32)
+            padded[0, :s0] = req.prompt
+            tok0, row_cache = self._prefill_fn(P)(
+                self.params, jnp.asarray(padded), jnp.int32(s0 - 1))
+            if req.max_new_tokens > 1:
+                # single-token requests finish at prefill; joining their
+                # row would copy the whole multi-slot cache for nothing
+                self._cache = self._join(self._cache, row_cache,
+                                         jnp.int32(i))
+            first = int(np.asarray(tok0)[0])  # host sync: token is real now
+            now = time.monotonic()
+            resp.ttft_s = now - req.t_submit
+            self.metrics.observe_ttft(resp.ttft_s)
+            self.metrics.observe_prefill(now - t_a)
+            if req.max_new_tokens == 1:
+                self._finish(req, resp, [first])
+            else:
+                self._slots[i] = _Slot(req, resp, pos=s0,
+                                       first_token=first, t_now=now)
+            admitted += 1
+        return admitted
+
+    def _decode_step(self, active: List[int]) -> None:
+        """One batched step over ALL slots (static shape); only active
+        rows advance host-side.  Inactive rows feed token 0 at position 0
+        — their slot is rewritten by the next join before the causal mask
+        can expose the garbage."""
+        jnp = self._jax.numpy
+        toks = np.zeros((self.max_slots,), np.int32)
+        poss = np.zeros((self.max_slots,), np.int32)
+        for i in active:
+            s = self._slots[i]
+            toks[i] = s.last
+            poss[i] = s.pos
+        t0 = time.monotonic()
+        logits, self._cache = self._step(self.params, self._cache,
+                                         jnp.asarray(toks),
+                                         jnp.asarray(poss))
+        nxt = np.asarray(jnp.argmax(logits, -1))  # host sync gates the feed
+        now = time.monotonic()
+        self.metrics.observe_step(now - t0, len(active))
+        for i in active:
+            s = self._slots[i]
+            tok = int(nxt[i])
+            s.generated.append(tok)
+            s.pos += 1
+            s.last = tok
+            s.remaining -= 1
+            self.metrics.observe_token_latency(now - s.t_last)
+            s.t_last = now
+            if s.remaining <= 0:
+                self._finish(s.req, s.resp, s.generated)
+                self._slots[i] = None  # retire = host-side slot free
+
+    def _finish(self, req: ServeRequest, resp: ServeResponse,
+                generated: List[int]) -> None:
+        tokens = np.concatenate(
+            [req.prompt, np.asarray(generated, np.int32)])
+        if resp._complete(tokens):
+            self.metrics.inc("completed")
+
+    def _cancel_slots(self) -> None:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if s.resp._fail(ServeCancelled(
+                    f"request {s.req.request_id} cancelled mid-decode: "
+                    "engine stopped with cancel_active")):
+                self.metrics.inc("cancelled")
+            self._slots[i] = None

@@ -1,0 +1,141 @@
+"""Serving metrics: throughput, queue depth, and tail latency.
+
+Serving is judged on its tail — a p50 dashboard hides the requests users
+actually complain about — so every latency family reports
+p50/p95/p99/max from ``utils.profiler``'s reservoir percentiles (the
+exact max survives reservoir eviction).  Three latency families:
+
+- **ttft** (time to first token): submit -> first token produced.  In a
+  continuous-batching engine this includes queue wait, so it IS the
+  admission/backpressure signal.
+- **token_latency**: gap between a request's consecutive tokens.  Under
+  continuous batching this tracks the shared step time — it degrades
+  gracefully as the batch fills, which is the throughput/latency trade
+  the engine exists to make.
+- **decode_step** / **prefill**: engine-internal phase timings.
+
+Counters are exactly-once by construction (incremented where the
+corresponding transition happens, guarded by the response's
+first-completion-wins contract), so ``completed + failed + cancelled``
+accounts for every admitted request — the no-lost-no-duplicated
+invariant the replica layer is tested against.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.profiler import Profiler
+
+
+class ServeMetrics:
+    """Counters + latency reservoirs for one engine (or replica group)."""
+
+    TTFT = "serve/ttft"
+    TOKEN = "serve/token_latency"
+    STEP = "serve/decode_step"
+    PREFILL = "serve/prefill"
+
+    _COUNTERS = ("submitted", "completed", "failed", "cancelled",
+                 "rejected", "requeued", "prefills", "tokens_generated",
+                 "steps", "steps_batch_gt1", "wedge_events")
+
+    def __init__(self, profiler: Optional[Profiler] = None):
+        self.profiler = profiler or Profiler()
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {k: 0 for k in self._COUNTERS}
+        self._max_batch = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._queue_depth: Callable[[], int] = lambda: 0
+
+    # ------------------------------------------------------------------ #
+    def bind_queue(self, depth_fn: Callable[[], int]) -> None:
+        """Wire the live queue-depth gauge (the batcher owns the number)."""
+        self._queue_depth = depth_fn
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+
+    def observe_ttft(self, dt_s: float) -> None:
+        self.profiler.observe(self.TTFT, dt_s)
+
+    def observe_token_latency(self, dt_s: float) -> None:
+        self.profiler.observe(self.TOKEN, dt_s)
+
+    def observe_prefill(self, dt_s: float) -> None:
+        """One admission prefill.  Counts the request's FIRST served token
+        (prefill produces it) and extends the busy window, so
+        throughput/tokens stay honest even for max_new_tokens=1 loads."""
+        self.profiler.observe(self.PREFILL, dt_s)
+        now = time.monotonic()
+        with self._lock:
+            self._c["prefills"] += 1
+            self._c["tokens_generated"] += 1
+            if self._t_first is None:
+                self._t_first = now - dt_s
+            self._t_last = now
+
+    def observe_step(self, dt_s: float, active: int) -> None:
+        """One continuous-batching decode step over ``active`` live slots
+        (inactive slots ride along at static shape; they are compute, not
+        service)."""
+        self.profiler.observe(self.STEP, dt_s)
+        now = time.monotonic()
+        with self._lock:
+            self._c["steps"] += 1
+            if active > 1:
+                self._c["steps_batch_gt1"] += 1
+            self._c["tokens_generated"] += active
+            self._max_batch = max(self._max_batch, active)
+            if self._t_first is None:
+                self._t_first = now - dt_s
+            self._t_last = now
+
+    # ------------------------------------------------------------------ #
+    def snapshot(self) -> Dict[str, Any]:
+        """Machine-readable report (bench-honesty style: flat, JSON-able).
+
+        ``throughput_tok_s`` divides generated tokens by the busy window
+        (first step start -> last step end), not process lifetime — an
+        idle engine must not look slow."""
+        s = self.profiler.summary()
+
+        def pct(name: str) -> Optional[Dict[str, float]]:
+            row = s.get(name)
+            if row is None:
+                return None
+            return {k: row[k] for k in ("count", "mean_s", "p50_s",
+                                        "p95_s", "p99_s", "max_s")}
+
+        with self._lock:
+            counters = dict(self._c)
+            max_batch = self._max_batch
+            busy_s = ((self._t_last - self._t_first)
+                      if self._t_first is not None
+                      and self._t_last is not None else 0.0)
+        out: Dict[str, Any] = dict(counters)
+        out["max_batch"] = max_batch
+        out["queue_depth"] = self._queue_depth()
+        out["busy_s"] = busy_s
+        out["throughput_tok_s"] = (
+            counters["tokens_generated"] / busy_s if busy_s > 0 else 0.0)
+        out["ttft_s"] = pct(self.TTFT)
+        out["token_latency_s"] = pct(self.TOKEN)
+        out["decode_step_s"] = pct(self.STEP)
+        out["prefill_s"] = pct(self.PREFILL)
+        return out
+
+    def describe(self) -> str:
+        """Human-readable snapshot + the profiler's latency table."""
+        snap = self.snapshot()
+        head = ", ".join(
+            f"{k}={snap[k]}" for k in
+            ("submitted", "completed", "failed", "cancelled", "rejected",
+             "requeued", "steps", "steps_batch_gt1", "max_batch",
+             "queue_depth"))
+        tput = f"throughput={snap['throughput_tok_s']:.1f} tok/s"
+        return f"{head}, {tput}\n{self.profiler.describe()}"

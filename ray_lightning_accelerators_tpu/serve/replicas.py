@@ -1,0 +1,290 @@
+"""N serve-engine replicas on the actor runtime, watchdog-supervised.
+
+Each replica is a ``runtime.actors.Worker`` subprocess owning a full
+engine (weights + cache + driver loop) — the per-replica eager execution
+model of veScale-style runtimes: the driver here is a thin router, not a
+participant in the math.  Requests flow driver -> replica as CHUNKS (one
+dispatch carries several requests, submitted to the replica's engine
+together so it continuous-batches them); responses flow back on the
+worker future.
+
+Failure model (the reason this layer exists):
+
+- a replica that DIES fails its chunk future with "worker died";
+- a replica that WEDGES (hung XLA dispatch, frozen process) never fails
+  anything on its own — the pool's ``Watchdog`` reaps it from heartbeat
+  staleness and the chunk future fails ``WorkerWedged``;
+- either way the chunk's unanswered requests are RE-QUEUED head-of-line
+  and complete on a surviving replica.  Responses are exactly-once by the
+  ``ServeResponse`` first-completion-wins contract, so a request is never
+  lost and never answered twice (``metrics`` proves the accounting).
+- a worker-side ``RemoteError`` (the engine itself raised) is an
+  APPLICATION failure: re-running it elsewhere would fail again, so it
+  fails the requests typed instead of poisoning every replica in turn.
+
+Replicas that went down stay down (capacity degrades, correctness does
+not); ``revive(rank)`` restarts and re-initializes one explicitly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.actors import ActorPool, RemoteError
+from ..runtime.watchdog import WorkerWedged
+from ..utils.logging import log
+from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
+                      ServeResponse)
+from .metrics import ServeMetrics
+
+# worker-process side: one engine per replica process, installed by
+# _replica_init (module-global so chunk dispatches find it)
+_ENGINE = None
+
+
+def _replica_init(engine_factory: Callable[[], Any]) -> bool:
+    """Build and start this replica's engine (runs IN the worker)."""
+    global _ENGINE
+    if _ENGINE is not None:
+        _ENGINE.stop(cancel_active=True)
+    _ENGINE = engine_factory()
+    _ENGINE.start()
+    return True
+
+
+def _replica_serve(items: List[Tuple[int, Any, int]]) -> List[
+        Tuple[int, Any]]:
+    """Serve one chunk (runs IN the worker).  Submit EVERY request before
+    waiting on any, so the engine joins them into shared decode steps —
+    this is where driver-level chunking becomes replica-level continuous
+    batching."""
+    if _ENGINE is None:
+        raise RuntimeError("replica engine not initialized")
+    handles = [(rid, _ENGINE.submit(np.asarray(prompt, np.int32), n))
+               for rid, prompt, n in items]
+    return [(rid, np.asarray(h.result())) for rid, h in handles]
+
+
+def _replica_stats() -> Dict[str, Any]:
+    """Engine metrics snapshot (runs IN the worker)."""
+    if _ENGINE is None:
+        raise RuntimeError("replica engine not initialized")
+    return _ENGINE.stats()
+
+
+class ServeReplicas:
+    """Router over ``num_replicas`` engine replicas with supervision.
+
+    ``engine_factory``: zero-arg callable building a STARTABLE
+    ``ServeEngine`` — it executes inside each worker process (ship numpy
+    params in the closure; the factory runs after the worker's jax
+    initializes).  ``chunk_size``: max requests per dispatch (the
+    replica's engine batches the chunk).  ``wedge_timeout_s`` /
+    ``heartbeat_s``: watchdog knobs, see runtime/watchdog.py.
+    ``max_requeues``: infra-failure retries per request before failing it
+    typed.
+    """
+
+    def __init__(self, engine_factory: Callable[[], Any],
+                 num_replicas: int = 2, *, queue_depth: int = 256,
+                 max_total_len: Optional[int] = None,
+                 chunk_size: int = 4, max_requeues: int = 2,
+                 heartbeat_s: Optional[float] = None,
+                 wedge_timeout_s: Optional[float] = None,
+                 supervise: bool = True,
+                 env_per_worker: Optional[List[Dict[str, str]]] = None,
+                 idle_poll_s: float = 0.02):
+        envs = [dict(e) for e in (env_per_worker
+                                  or [{} for _ in range(num_replicas)])]
+        if heartbeat_s is not None:
+            for e in envs:
+                e.setdefault("RLA_TPU_WORKER_HEARTBEAT_S",
+                             str(heartbeat_s))
+        self.chunk_size = max(1, chunk_size)
+        self.max_requeues = max_requeues
+        self.metrics = ServeMetrics()
+        self.batcher = AdmissionController(queue_depth=queue_depth,
+                                           max_total_len=max_total_len)
+        self.metrics.bind_queue(lambda: self.batcher.depth)
+        self._idle_poll_s = idle_poll_s
+        self._lock = threading.Lock()
+        self._down: set = set()
+        self._busy: set = set()
+        self._next_rank = 0
+        self._stop = threading.Event()
+        self._engine_factory = engine_factory
+        self.pool = ActorPool(num_replicas, env_per_worker=envs)
+        try:
+            for f in self.pool.execute_all(_replica_init, engine_factory):
+                f.result()
+            self.watchdog = (self.pool.watch(
+                wedge_timeout_s=wedge_timeout_s) if supervise else None)
+        except BaseException:
+            self.pool.kill()
+            raise
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="rla-tpu-serve-dispatch")
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------------ #
+    # Client surface                                                     #
+    # ------------------------------------------------------------------ #
+    def submit(self, prompt: Any, max_new_tokens: int) -> ServeResponse:
+        from .batcher import QueueFull, RequestRejected
+        try:
+            resp = self.batcher.submit(prompt, max_new_tokens)
+        except (QueueFull, RequestRejected):
+            # admission rejections only -- shutdown's ServeCancelled must
+            # not read as overload
+            self.metrics.inc("rejected")
+            raise
+        self.metrics.inc("submitted")
+        return resp
+
+    def stats(self) -> Dict[str, Any]:
+        out = self.metrics.snapshot()
+        out["replicas"] = len(self.pool)
+        with self._lock:
+            out["replicas_down"] = sorted(self._down)
+        if self.watchdog is not None:
+            out["supervision"] = self.watchdog.report()
+        return out
+
+    def replica_stats(self, rank: int) -> Dict[str, Any]:
+        """A live replica's own engine metrics (proves in-replica
+        batching: its ``steps_batch_gt1`` counts shared decode steps)."""
+        return self.pool.workers[rank].execute(_replica_stats).result()
+
+    def revive(self, rank: int) -> None:
+        """Restart a downed replica and re-initialize its engine."""
+        w = self.pool.workers[rank]
+        w.restart()
+        w.execute(_replica_init, self._engine_factory).result()
+        with self._lock:
+            self._down.discard(rank)
+            self._busy.discard(rank)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.batcher.kick()
+        self._dispatcher.join(timeout=30)
+        n = self.batcher.shutdown()
+        if n:
+            self.metrics.inc("cancelled", n)
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.pool.shutdown()
+
+    def __enter__(self) -> "ServeReplicas":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ------------------------------------------------------------------ #
+    # Dispatch                                                           #
+    # ------------------------------------------------------------------ #
+    def _pick_replica(self) -> Optional[int]:
+        """Round-robin over live, idle replicas (round-robin spreads load
+        so a hang anywhere is actually exercised, not avoided)."""
+        n = len(self.pool)
+        with self._lock:
+            for off in range(n):
+                rank = (self._next_rank + off) % n
+                if rank in self._down or rank in self._busy:
+                    continue
+                if not self.pool.workers[rank].is_alive:
+                    self._down.add(rank)
+                    continue
+                self._busy.add(rank)
+                self._next_rank = (rank + 1) % n
+                return rank
+        return None
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.batcher.wait_for_work(self._idle_poll_s):
+                continue
+            with self._lock:
+                all_down = len(self._down) >= len(self.pool)
+            if all_down:
+                # no capacity will ever come back on its own: fail the
+                # queue typed rather than hang every caller forever
+                for req, resp in iter(self.batcher.pop, None):
+                    if resp._fail(ServeCancelled(
+                            f"request {req.request_id}: every replica is "
+                            "down")):
+                        self.metrics.inc("failed")
+                time.sleep(self._idle_poll_s)
+                continue
+            rank = self._pick_replica()
+            if rank is None:
+                time.sleep(self._idle_poll_s)
+                continue
+            chunk: List[Tuple[ServeRequest, ServeResponse]] = []
+            while len(chunk) < self.chunk_size:
+                item = self.batcher.pop()
+                if item is None:
+                    break
+                chunk.append(item)
+            if not chunk:
+                with self._lock:
+                    self._busy.discard(rank)
+                continue
+            items = [(req.request_id, req.prompt, req.max_new_tokens)
+                     for req, _ in chunk]
+            fut = self.pool.workers[rank].execute(_replica_serve, items)
+            fut.add_done_callback(
+                lambda f, r=rank, c=chunk: self._on_chunk_done(r, c, f))
+
+    def _on_chunk_done(self, rank: int,
+                       chunk: List[Tuple[ServeRequest, ServeResponse]],
+                       fut) -> None:
+        """Runs on the worker's collector thread: settle or re-queue."""
+        with self._lock:
+            self._busy.discard(rank)
+        exc = fut.exception()
+        if exc is None:
+            results = dict(fut.result())
+            for req, resp in chunk:
+                tokens = results.get(req.request_id)
+                if tokens is None:
+                    self._requeue_or_fail(req, resp, RuntimeError(
+                        f"replica {rank} returned no result for request "
+                        f"{req.request_id}"))
+                elif resp._complete(tokens):
+                    self.metrics.inc("completed")
+            return
+        if isinstance(exc, RemoteError):
+            # application failure: deterministic, don't poison survivors
+            log.error("replica %d failed a chunk application-side: %s",
+                      rank, exc)
+            for req, resp in chunk:
+                if resp._fail(exc):
+                    self.metrics.inc("failed")
+            return
+        # infra failure: wedged (watchdog reap) or died -- requeue
+        with self._lock:
+            self._down.add(rank)
+        if isinstance(exc, WorkerWedged):
+            self.metrics.inc("wedge_events")
+        log.warning("replica %d lost mid-chunk (%s); re-queuing %d "
+                    "request(s)", rank, type(exc).__name__, len(chunk))
+        for req, resp in chunk:
+            self._requeue_or_fail(req, resp, exc)
+
+    def _requeue_or_fail(self, req: ServeRequest, resp: ServeResponse,
+                         exc: BaseException) -> None:
+        if resp.done():
+            return
+        if req.requeues >= self.max_requeues:
+            if resp._fail(exc):
+                self.metrics.inc("failed")
+            return
+        if self.batcher.requeue(req, resp):
+            self.metrics.inc("requeued")

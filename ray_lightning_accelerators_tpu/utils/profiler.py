@@ -44,17 +44,23 @@ class _SpanHandle:
 
 
 class _SpanStat:
-    __slots__ = ("count", "total", "samples", "_rng")
+    __slots__ = ("count", "total", "samples", "maxv", "_rng")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.samples: List[float] = []  # uniform reservoir for percentiles
+        # exact running max: the worst span must survive even after the
+        # reservoir evicts it (tail-latency honesty -- serving is judged
+        # on its worst request, not its worst sampled request)
+        self.maxv = 0.0
         self._rng = random.Random(0x5EED)
 
     def add(self, dt: float, cap: int = 4096) -> None:
         self.count += 1
         self.total += dt
+        if dt > self.maxv:
+            self.maxv = dt
         # reservoir sampling: every span has equal probability of being in
         # the percentile sample, so long runs aren't summarized by their
         # first cap spans (compile/warmup) alone
@@ -118,23 +124,37 @@ class Profiler:
             with self._lock:
                 self._stats.setdefault(full, _SpanStat()).add(dt)
 
+    def observe(self, name: str, dt_s: float) -> None:
+        """Record an externally timed duration under ``name`` — the same
+        statistics as a span without entering one.  Serving metrics time
+        request lifecycles (submit -> first token) that are not a single
+        with-block on one thread."""
+        with self._lock:
+            self._stats.setdefault(name, _SpanStat()).add(dt_s)
+
     # ------------------------------------------------------------------ #
     def summary(self) -> Dict[str, Dict[str, float]]:
-        """name -> {count, total_s, mean_s, p50_s, p95_s}."""
+        """name -> {count, total_s, mean_s, p50_s, p95_s, p99_s, max_s}.
+
+        Percentiles come from the uniform reservoir; ``max_s`` is the
+        exact running maximum (tail latency is judged on the worst span,
+        which the reservoir may have evicted)."""
         out: Dict[str, Dict[str, float]] = {}
         with self._lock:
-            items = list(self._stats.items())
-        for name, st in items:
-            xs = sorted(st.samples)
+            items = [(name, st.count, st.total, sorted(st.samples),
+                      st.maxv) for name, st in self._stats.items()]
+        for name, count, total, xs, maxv in items:
             pick = (lambda q: xs[min(len(xs) - 1,
                                      int(math.ceil(q * len(xs))) - 1)]
                     if xs else 0.0)
             out[name] = {
-                "count": st.count,
-                "total_s": st.total,
-                "mean_s": st.total / max(st.count, 1),
+                "count": count,
+                "total_s": total,
+                "mean_s": total / max(count, 1),
                 "p50_s": pick(0.50),
                 "p95_s": pick(0.95),
+                "p99_s": pick(0.99),
+                "max_s": maxv,
             }
         return out
 
@@ -143,12 +163,13 @@ class Profiler:
         rows = sorted(self.summary().items(),
                       key=lambda kv: -kv[1]["total_s"])
         lines = [f"{'span':<40} {'count':>7} {'total':>9} {'mean':>9} "
-                 f"{'p50':>9} {'p95':>9}"]
+                 f"{'p50':>9} {'p95':>9} {'p99':>9} {'max':>9}"]
         for name, s in rows:
             lines.append(
                 f"{name:<40} {s['count']:>7d} {s['total_s']:>8.3f}s "
                 f"{s['mean_s'] * 1e3:>7.2f}ms {s['p50_s'] * 1e3:>7.2f}ms "
-                f"{s['p95_s'] * 1e3:>7.2f}ms")
+                f"{s['p95_s'] * 1e3:>7.2f}ms {s['p99_s'] * 1e3:>7.2f}ms "
+                f"{s['max_s'] * 1e3:>7.2f}ms")
         return "\n".join(lines)
 
     def reset(self) -> None:

@@ -1,0 +1,107 @@
+"""Serve engine probe: one JSON line of throughput/latency truth.
+
+Bench-honesty rules (the decode_probe.py discipline, applied to
+serving): the numbers come from the engine's own metrics — reservoir
+percentiles plus an EXACT max — over real requests driven through the
+real admission/decode path, with compile/warmup excluded by a warmup
+request per prompt bucket before the measured window.  Failures emit an
+``{"error": ...}`` line instead of a traceback so a wedged backend still
+produces a parseable record.
+
+Usage::
+
+    python scripts/serve_probe.py [--requests N] [--slots S] [--seed K]
+
+Output (one line)::
+
+    {"probe": "serve", "requests": ..., "max_slots": ...,
+     "throughput_tok_s": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
+     "token_p50_ms": ..., "token_p99_ms": ..., "token_max_ms": ...,
+     "steps": ..., "steps_batch_gt1": ..., "max_batch": ...}
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _arg(flag: str, default: int) -> int:
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+def probe(n_requests: int, max_slots: int, seed: int) -> dict:
+    import jax
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    from ray_lightning_accelerators_tpu.serve import ServeEngine
+
+    cfg = TransformerConfig(vocab_size=512, d_model=128, n_heads=4,
+                            d_ff=256, n_layers=4, max_seq_len=256)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+
+    def prompts(n):
+        return [rng.integers(0, cfg.vocab_size,
+                             size=(int(rng.integers(4, 32)),)
+                             ).astype(np.int32) for _ in range(n)]
+
+    with ServeEngine(model, params, max_slots=max_slots,
+                     queue_depth=max(64, 2 * n_requests)) as engine:
+        # warmup: touch EVERY prompt-length bucket the measured window
+        # can hit (lengths 4..31 -> one prompt per prompt_block bucket)
+        # plus the join/step programs, so the window bills decode, not
+        # XLA compiles
+        blk = engine.prompt_block
+        for s0 in range(blk, 33, blk):
+            p = rng.integers(0, cfg.vocab_size,
+                             size=(max(1, s0 - 1),)).astype(np.int32)
+            engine.submit(p, 2).result(timeout=600)
+        engine.metrics.profiler.reset()
+
+        handles = [engine.submit(p, int(rng.integers(8, 33)))
+                   for p in prompts(n_requests)]
+        for h in handles:
+            h.result(timeout=600)
+        snap = engine.stats()
+
+    def ms(fam, key):
+        row = snap.get(fam) or {}
+        return round(1e3 * row.get(key, 0.0), 3)
+
+    return {
+        "probe": "serve", "requests": n_requests, "max_slots": max_slots,
+        "tokens_generated": snap["tokens_generated"],
+        "busy_s": round(snap["busy_s"], 3),
+        "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+        "ttft_p50_ms": ms("ttft_s", "p50_s"),
+        "ttft_p99_ms": ms("ttft_s", "p99_s"),
+        "ttft_max_ms": ms("ttft_s", "max_s"),
+        "token_p50_ms": ms("token_latency_s", "p50_s"),
+        "token_p99_ms": ms("token_latency_s", "p99_s"),
+        "token_max_ms": ms("token_latency_s", "max_s"),
+        "steps": snap["steps"],
+        "steps_batch_gt1": snap["steps_batch_gt1"],
+        "max_batch": snap["max_batch"],
+    }
+
+
+def main() -> None:
+    try:
+        rec = probe(_arg("--requests", 16), _arg("--slots", 4),
+                    _arg("--seed", 0))
+    except Exception as e:
+        rec = {"probe": "serve",
+               "error": f"{type(e).__name__}: {e}"[:400]}
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

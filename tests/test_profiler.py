@@ -25,11 +25,35 @@ def test_spans_nest_and_count():
     assert s["outer"]["count"] == 3
     assert s["outer/inner"]["count"] == 3
     assert s["outer"]["total_s"] >= s["outer/inner"]["total_s"] > 0
-    for k in ("count", "total_s", "mean_s", "p50_s", "p95_s"):
+    for k in ("count", "total_s", "mean_s", "p50_s", "p95_s", "p99_s",
+              "max_s"):
         assert k in s["outer"]
     assert "outer/inner" in prof.describe()
     prof.reset()
     assert prof.summary() == {}
+
+
+def test_tail_percentiles_and_exact_max():
+    """p99 sits in the tail of the reservoir and max_s is the EXACT
+    maximum (it must survive even when the reservoir would evict it)."""
+    prof = Profiler()
+    for i in range(1, 101):           # 1ms..100ms, deterministic
+        prof.observe("op", i / 1000.0)
+    s = prof.summary()["op"]
+    assert s["count"] == 100
+    assert s["p50_s"] == pytest.approx(0.050, abs=0.002)
+    assert s["p95_s"] == pytest.approx(0.095, abs=0.002)
+    assert s["p99_s"] == pytest.approx(0.099, abs=0.002)
+    assert s["p99_s"] >= s["p95_s"] >= s["p50_s"]
+    assert s["max_s"] == pytest.approx(0.100)
+    # beyond the reservoir cap the exact max still survives
+    prof.observe("op", 9.9)
+    for _ in range(5000):
+        prof.observe("op", 0.001)
+    assert prof.summary()["op"]["max_s"] == pytest.approx(9.9)
+    # describe() renders the new tail columns
+    head = prof.describe().splitlines()[0]
+    assert "p99" in head and "max" in head
 
 
 def test_sync_span_blocks_on_device_outputs():

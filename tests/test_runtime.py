@@ -106,6 +106,28 @@ def test_workers_dead_after_shutdown():
     assert not any(p.is_alive() for p in procs)
 
 
+def test_queue_shutdown_idempotent_drains_and_rejects():
+    """TrampolineQueue.shutdown(): safe with requests still enqueued —
+    drains them unexecuted (the caller cancels them typed), rejects later
+    put()s with QueueShutdown, and is idempotent.  The serve engine's
+    cancellation path rides this."""
+    from ray_lightning_accelerators_tpu.runtime.queue import QueueShutdown
+
+    ran = []
+    q = TrampolineQueue()
+    q.put((0, lambda: ran.append("a")))
+    q.put((1, lambda: ran.append("b")))
+    drained = q.shutdown()
+    assert [r for r, _ in drained] == [0, 1]
+    assert ran == []                      # drained, never executed
+    assert q.closed
+    assert q.get_nowait() is None
+    assert q.shutdown() == []             # idempotent no-op
+    with pytest.raises(QueueShutdown):
+        q.put((2, lambda: ran.append("c")))
+    assert ran == []
+
+
 def test_process_results_pumps_queue_during_run():
     q = TrampolineQueue()
     seen = []

@@ -98,9 +98,14 @@ def test_speculative_with_gqa_target():
 def test_speculative_rejects_batch_and_window():
     target, tp = _model(1, 0)
     draft, dp = _model(1, 1)
-    with pytest.raises(ValueError, match="batch"):
+    with pytest.raises(ValueError, match="single-stream"):
         speculative_generate(target, tp, draft, dp,
                              jnp.ones((2, 4), jnp.int32), 4)
+    # the check is explicit about SHAPE, not just batch: a 1-D prompt
+    # must not slip through as "batch == seq_len" confusion
+    with pytest.raises(ValueError, match=r"\[1, prompt_len\]"):
+        speculative_generate(target, tp, draft, dp,
+                             jnp.ones((4,), jnp.int32), 4)
     swcfg = TransformerConfig(vocab_size=61, d_model=64, n_heads=2,
                               d_ff=128, n_layers=1, max_seq_len=64,
                               sliding_window=8)
