@@ -145,8 +145,16 @@ class Accelerator:
             if params_sharded:
                 log.warning("state_shardings called without tx; optimizer "
                             "moments will be fully replicated")
+        # gradient-compression state (parallel/collectives.py): stacked
+        # per-replica trees, dim 0 over the batch axes; None when unused
+        from ..parallel import collectives as collectives_lib
+        extras = {
+            field: (None if getattr(state, field, None) is None
+                    else collectives_lib.stacked_shardings(
+                        mesh, getattr(state, field)))
+            for field in ("residual", "grad_accum")}
         return state.replace(step=repl, params=param_sh, opt_state=opt_sh,
-                             rng=repl)
+                             rng=repl, **extras)
 
     # ---------------------------------------------------------------- #
     # Multi-host launch plan                                            #

@@ -22,15 +22,28 @@ class TrainState:
     params: Any                # model parameter pytree
     opt_state: Any             # optax state pytree
     rng: jax.Array             # base PRNG key; per-step keys are fold_in(step)
+    # quantized gradient exchange (parallel/collectives.py), both None
+    # unless Trainer(grad_compression=...) is set:
+    # - residual: per-replica error-feedback residuals, one [n_replicas,
+    #   leaf.size] f32 buffer per compressed leaf (the quantization error
+    #   each replica carries into its next exchange)
+    # - grad_accum: per-replica local-gradient accumulators
+    #   [n_replicas, *leaf.shape] for accumulate_grad_batches > 1, so the
+    #   exchange (the only comms) runs once per accumulation boundary
+    residual: Any = None
+    grad_accum: Any = None
 
     @classmethod
     def create(cls, params: Any, tx: optax.GradientTransformation,
-               rng: jax.Array) -> "TrainState":
+               rng: jax.Array, residual: Any = None,
+               grad_accum: Any = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
             rng=rng,
+            residual=residual,
+            grad_accum=grad_accum,
         )
 
     @property

@@ -39,6 +39,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import _pick_block
 
+# jax 0.4.x names it TPUCompilerParams; 0.5+ renamed to CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 # the kernels take the whole M dimension per grid cell: the f32
 # accumulator scratch [M, bn] + the [M, bk] input block must fit VMEM
 # (~16 MB/core) with room for double-buffered weight blocks.  Decode
@@ -98,6 +102,29 @@ def supported(m: int, k: int, n: int) -> bool:
             and _pick_block(512, n) is not None and k % 32 == 0)
 
 
+def _check_supported(fn: str, m: int, k: int, n: int) -> None:
+    """Typed rejection of shapes the kernels cannot tile.  Call sites
+    that want the silent XLA-dequant fallback pre-check ``supported()``;
+    a direct call with a bad shape gets a ValueError naming the
+    constraint instead of a Mosaic compile error (or a silent
+    None-arithmetic TypeError) deep in pallas_call."""
+    if not 1 <= m <= _MAX_M:
+        raise ValueError(
+            f"{fn}: m={m} outside [1, {_MAX_M}] (whole-M-per-cell kernels "
+            f"must fit the [M, block] accumulator in VMEM)")
+    if k % 32 != 0 or _pick_block(512, k) is None:
+        raise ValueError(
+            f"{fn}: contraction dim k={k} is not tileable -- k must be a "
+            f"multiple of 32 (int8 sublane tile) and divisible into "
+            f"128-lane blocks; check supported(m, k, n) and fall back to "
+            f"the XLA dequant path")
+    if _pick_block(512, n) is None:
+        raise ValueError(
+            f"{fn}: output dim n={n} is not divisible into 128-lane "
+            f"blocks; check supported(m, k, n) and fall back to the XLA "
+            f"dequant path")
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def int8_matmul(x: jax.Array, wq: jax.Array, scale: jax.Array,
                 interpret: bool = False) -> jax.Array:
@@ -107,7 +134,15 @@ def int8_matmul(x: jax.Array, wq: jax.Array, scale: jax.Array,
     scale[j]) -- exactly ``x @ (wq.astype(f32) * scale[None, :])``."""
     m, k = x.shape
     k2, n = wq.shape
-    assert k == k2 and scale.shape == (n,)
+    if k != k2:
+        raise ValueError(
+            f"int8_matmul: x contraction dim {k} != wq leading dim {k2} "
+            f"(x {x.shape} @ wq {wq.shape})")
+    if scale.shape != (n,):
+        raise ValueError(
+            f"int8_matmul: scale must be per-out-channel with shape "
+            f"({n},), got {scale.shape}")
+    _check_supported("int8_matmul", m, k, n)
     bk = _pick_block(512, k)
     bn = _pick_block(512, n)
     s2 = scale.reshape(1, n).astype(jnp.float32)
@@ -123,7 +158,7 @@ def int8_matmul(x: jax.Array, wq: jax.Array, scale: jax.Array,
         out_specs=pl.BlockSpec((m, bn), lambda j, kk: (0, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, wq, s2)
@@ -136,7 +171,11 @@ def int8_matmul_nt(x: jax.Array, wq: jax.Array,
     contraction-dim scales into x first)."""
     m, k = x.shape
     n, k2 = wq.shape
-    assert k == k2
+    if k != k2:
+        raise ValueError(
+            f"int8_matmul_nt: x contraction dim {k} != wq trailing dim "
+            f"{k2} (x {x.shape} @ wq {wq.shape}^T)")
+    _check_supported("int8_matmul_nt", m, k, n)
     bk = _pick_block(512, k)
     bn = _pick_block(512, n)
     grid = (n // bn, k // bk)
@@ -150,7 +189,7 @@ def int8_matmul_nt(x: jax.Array, wq: jax.Array,
         out_specs=pl.BlockSpec((m, bn), lambda j, kk: (0, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, wq)

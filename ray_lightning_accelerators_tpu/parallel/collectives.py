@@ -1,0 +1,384 @@
+"""Communication-efficient gradient exchange: quantized allreduce + ZeRO-1.
+
+The trainer's data-parallel gradient exchange is an *implicit* fp32
+allreduce: params are replicated, the batch is sharded, and XLA emits the
+psum inside the backward pass (core/trainer.py).  That is correct and
+fast on one host, but past a single host the two dominant costs of scaling
+data parallelism are (1) full-precision gradient bytes on the wire and
+(2) every replica holding a full copy of the optimizer state.  This module
+attacks both, each opt-in and composable, both living INSIDE the jitted
+train step so XLA fuses them (no extra dispatch):
+
+**Quantized allreduce** (EQuARX-style, arxiv 2506.17615).  Each replica's
+local gradients are exchanged explicitly through a ``shard_map`` over the
+batch axes: per-block int8 (or bf16) compression with per-block scales, a
+two-phase bandwidth-optimal exchange (block-quantized all_to_all =
+reduce-scatter in int8, then re-quantize + all_gather), and persistent
+error-feedback residuals so the quantization error is carried forward
+instead of lost (residuals live in ``TrainState.residual``).  Leaves
+smaller than ``min_compress_size`` stay fp32 through a plain psum — tiny
+tensors are latency-, not bandwidth-bound, and scales would dominate.
+
+**ZeRO-1 optimizer-state sharding** (Xu et al., arxiv 2004.13336).  Each
+replica owns a ``1/N`` shard of the optimizer state (dim 0 of every
+param-shaped moment, where divisible), applies its shard of the update,
+and the updated params are all-gathered — expressed purely as sharding
+constraints, so XLA partitions the update computation.  The gradient
+allreduce is pinned replicated first, which is what makes the result
+**bit-identical** to replicated training: the reduce is unchanged and the
+update itself is elementwise.
+
+Wire accounting is analytic (``wire_bytes_per_step``): ring-allreduce
+fp32 moves ``2*(N-1)/N * 4`` bytes per element per device; the two-phase
+int8 exchange moves ``2*(N-1)/N * (1 + 4/block)`` — a ~3.9x reduction at
+block 256, reported per-step through ``utils.profiler.Profiler``'s comms
+hook so the win is observable, not asserted.
+
+No reference analog: the reference delegated gradient exchange wholesale
+to torch DDP's bucketed fp32 allreduce (ray_lightning/ray_ddp.py:222-237).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+COMPRESSION_MODES = (None, "int8", "bf16")
+
+# int8 quantization granularity: one f32 scale per this many elements.
+# 256 keeps scale overhead at 4/256 = 1.6% of payload while staying well
+# inside the regime where a block's maxabs tracks its contents.
+DEFAULT_BLOCK = 256
+
+# leaves below this element count stay fp32 (plain psum): biases and norm
+# scales are latency-bound, and per-block scales would eat the savings
+DEFAULT_MIN_COMPRESS_SIZE = 2048
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeConfig:
+    """Gradient-exchange policy for one trainer run."""
+
+    mode: Optional[str] = None          # None | "int8" | "bf16"
+    block: int = DEFAULT_BLOCK
+    min_compress_size: int = DEFAULT_MIN_COMPRESS_SIZE
+
+    def __post_init__(self):
+        if self.mode not in COMPRESSION_MODES:
+            raise ValueError(
+                f"grad_compression must be one of {COMPRESSION_MODES}, "
+                f"got {self.mode!r}")
+        if self.block < 1:
+            raise ValueError(f"block must be >= 1, got {self.block}")
+
+
+def dp_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axes a data-parallel gradient exchange reduces over."""
+    return tuple(mesh_lib.BATCH_AXES)
+
+
+def dp_size(mesh: Mesh) -> int:
+    return mesh_lib.data_parallel_size(mesh)
+
+
+def validate_mesh_for_compression(mesh: Mesh) -> None:
+    """Quantized exchange replaces the DP psum only: params must be
+    replicated over every mesh axis, so any model-parallel axis > 1 (whose
+    gradients are NOT pure replicas) is a configuration error."""
+    bad = {a: s for a, s in mesh.shape.items()
+           if a not in mesh_lib.BATCH_AXES and s > 1}
+    if bad:
+        raise ValueError(
+            f"grad_compression requires a pure data-parallel mesh; "
+            f"model-parallel axes {bad} are > 1.  Quantized allreduce "
+            f"exchanges replicated-param gradients over {mesh_lib.BATCH_AXES} "
+            f"only — drop the compression flag or the model-parallel axes.")
+
+
+def compressible(leaf, cfg: ExchangeConfig) -> bool:
+    """Static (shape/dtype-level) decision: does this gradient leaf ride
+    the compressed path or stay fp32?"""
+    if cfg.mode is None or not hasattr(leaf, "shape"):
+        return False
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+        return False
+    return int(np.prod(leaf.shape)) >= cfg.min_compress_size
+
+
+# --------------------------------------------------------------------- #
+# Block quantization (pure, also used by tests and the bench probe)      #
+# --------------------------------------------------------------------- #
+def quantize_blocks(v: jax.Array, block: int) -> Tuple[jax.Array, jax.Array]:
+    """Flat f32 vector -> (int8 [nb, block], f32 scales [nb]).
+
+    ``v.size`` must already be a multiple of ``block`` (pad first).  Scales
+    are per-block symmetric maxabs/127; an all-zero block gets scale 1 so
+    dequantization never divides by zero."""
+    blocks = v.astype(jnp.float32).reshape(-1, block)
+    maxabs = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(maxabs > 0, maxabs / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_blocks(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of ``quantize_blocks``; returns flat f32 [nb * block]."""
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+
+
+def _pad_to(v: jax.Array, multiple: int) -> Tuple[jax.Array, int]:
+    n = v.size
+    pad = (-n) % multiple
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+    return v, n
+
+
+# --------------------------------------------------------------------- #
+# In-step exchange (runs INSIDE a shard_map body)                        #
+# --------------------------------------------------------------------- #
+def _exchange_int8(v, axes, n, block):
+    """Two-phase block-int8 allreduce-mean of one flat local leaf.
+
+    Phase 1 — quantized reduce-scatter: quantize the whole local leaf in
+    blocks, all_to_all the int8 blocks (+ scales) so each replica receives
+    every peer's copy of its owned 1/N block range, dequantize and sum.
+    Phase 2 — quantized all-gather: re-quantize the owned reduced range,
+    all_gather the int8 blocks (+ scales), dequantize into the full mean.
+    int8 is what crosses the wire in both phases; scales are f32 but
+    1/block the volume.  Returns (global_mean_flat, local_dequant_flat)
+    — the latter is what error feedback subtracts."""
+    q, s = quantize_blocks(v, block)                # [nb, block], [nb]
+    # error feedback compensates the local (phase-1) quantization error
+    local_dq = dequantize_blocks(q, s)
+    # shard blocks over replicas for the all_to_all; nb is padded to a
+    # multiple of n by the caller
+    peers_q = jax.lax.all_to_all(q, axes, split_axis=0, concat_axis=0,
+                                 tiled=True)        # [nb, block]
+    peers_s = jax.lax.all_to_all(s, axes, split_axis=0, concat_axis=0,
+                                 tiled=True)        # [nb]
+    nb = q.shape[0]
+    own = (peers_q.astype(jnp.float32).reshape(n, nb // n, block)
+           * peers_s.reshape(n, nb // n, 1)).sum(0) / n   # [nb/n, block]
+    q2, s2 = quantize_blocks(own.reshape(-1), block)
+    all_q = jax.lax.all_gather(q2, axes, axis=0, tiled=True)   # [nb, block]
+    all_s = jax.lax.all_gather(s2, axes, axis=0, tiled=True)   # [nb]
+    return dequantize_blocks(all_q, all_s), local_dq
+
+
+def _exchange_bf16(v, axes, n):
+    """bf16-on-the-wire allreduce-mean: cast, all_to_all shards, sum in
+    f32, re-cast, all_gather.  Same two-phase structure as int8 (2x wire
+    reduction); error feedback compensates the local cast error."""
+    c = v.astype(jnp.bfloat16)
+    local_dq = c.astype(jnp.float32)
+    shards = c.reshape(n, -1)
+    peers = jax.lax.all_to_all(shards, axes, split_axis=0, concat_axis=0,
+                               tiled=True).reshape(n, -1)
+    own = peers.astype(jnp.float32).sum(0) / n
+    gathered = jax.lax.all_gather(own.astype(jnp.bfloat16), axes,
+                                  axis=0, tiled=True)
+    return gathered.astype(jnp.float32), local_dq
+
+
+def _exchange_leaf_in_body(g, r, axes, n, cfg: ExchangeConfig):
+    """One leaf inside the shard_map body: (local grad, local residual) ->
+    (global mean grad, new residual).  ``g``/``r`` carry the leading
+    length-1 replica axis shard_map gives per-device blocks."""
+    g = g.reshape(g.shape[1:])   # drop the replica axis ([1, ...] block)
+    r = r.reshape(r.shape[1:])
+    if not compressible(g, cfg):
+        # fp32 path: plain psum-mean, no residual (no compression error)
+        out = jax.lax.psum(g, axes) / n
+        return out, r
+    orig_dtype, shape = g.dtype, g.shape
+    v = g.astype(jnp.float32).reshape(-1) + r.reshape(-1)
+    if cfg.mode == "bf16":
+        v_pad, true_n = _pad_to(v, n)
+        mean, local_dq = _exchange_bf16(v_pad, axes, n)
+    else:
+        v_pad, true_n = _pad_to(v, n * cfg.block)
+        mean, local_dq = _exchange_int8(v_pad, axes, n, cfg.block)
+    new_r = (v_pad - local_dq)[:true_n]
+    out = mean[:true_n].reshape(shape).astype(orig_dtype)
+    return out, new_r.reshape(r.shape)
+
+
+def residual_zeros(params, n: int, cfg: ExchangeConfig):
+    """Per-replica error-feedback residuals: a [n, leaf.size] f32 buffer
+    per compressible leaf, a [n, 1] placeholder otherwise (keeps the tree
+    congruent with the gradient tree for tree_map without burning memory
+    on leaves the fp32 path never touches)."""
+    def one(p):
+        size = int(np.prod(p.shape)) if compressible(p, cfg) else 1
+        return jnp.zeros((n, size), jnp.float32)
+    return jax.tree.map(one, params)
+
+
+def accum_zeros(params, n: int):
+    """Per-replica local-gradient accumulators ([n, *leaf.shape]) for
+    compress-once-per-accumulation-boundary micro-batching."""
+    return jax.tree.map(
+        lambda p: jnp.zeros((n,) + tuple(p.shape), jnp.float32), params)
+
+
+def stacked_shardings(mesh: Mesh, tree):
+    """NamedShardings for [n, ...]-stacked per-replica trees (residuals,
+    accumulators): dim 0 over the batch axes, rest replicated."""
+    sh = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+    return jax.tree.map(lambda _: sh, tree)
+
+
+def build_exchange(mesh: Mesh, cfg: ExchangeConfig):
+    """The jit-composable exchange: (stacked local grads [n, *shape],
+    stacked residuals [n, size]) -> (global mean grads, new residuals).
+
+    Inputs/outputs are stacked over a leading replica axis sharded on the
+    batch axes; outputs' gradient tree is replicated.  Call inside the
+    jitted train step — XLA fuses the collectives with the surrounding
+    program."""
+    axes = dp_axis_names(mesh)
+    n = dp_size(mesh)
+
+    def body(stacked_grads, stacked_res):
+        flat_g, treedef = jax.tree.flatten(stacked_grads)
+        flat_r = treedef.flatten_up_to(stacked_res)
+        outs = [_exchange_leaf_in_body(g, r, axes, n, cfg)
+                for g, r in zip(flat_g, flat_r)]
+        grads = treedef.unflatten([o[0] for o in outs])
+        new_res = treedef.unflatten([o[1][None] for o in outs])
+        return grads, new_res
+
+    lead = P(mesh_lib.BATCH_AXES)
+    return shard_map(body, mesh=mesh, in_specs=(lead, lead),
+                     out_specs=(P(), lead), check_rep=False)
+
+
+def build_local_grads(mesh: Mesh, value_and_grad_fn, batch_spec,
+                      extra_metrics=None):
+    """Per-replica gradient computation: runs ``value_and_grad_fn(params,
+    batch, rng) -> ((loss, metrics), grads)`` on each replica's batch
+    shard WITHOUT the implicit psum, returning pmean'd metrics (replicated)
+    and the raw local grads stacked [n, *shape] (sharded on batch axes).
+
+    ``extra_metrics(grads) -> dict`` (optional) runs in-body on the LOCAL
+    grads with the dp axes bound, so it may use psum/pmean — the
+    grad-norm hook rides this."""
+    axes = dp_axis_names(mesh)
+
+    def body(params, batch, rng):
+        # decorrelate per-replica stochasticity: the incoming key is
+        # replicated, and a shared key would sample IDENTICAL dropout/
+        # augmentation masks on every replica (the baseline path draws
+        # one mask over the whole global batch; here each replica must
+        # draw its own for its shard)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axes))
+        (_, metrics), grads = value_and_grad_fn(params, batch, rng)
+        metrics = jax.tree.map(lambda m: jax.lax.pmean(m, axes), metrics)
+        if extra_metrics is not None:
+            metrics.update(extra_metrics(grads))
+        stacked = jax.tree.map(lambda g: g[None], grads)
+        return metrics, stacked
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(), batch_spec, P()),
+        out_specs=(P(), P(mesh_lib.BATCH_AXES)), check_rep=False)
+
+
+# --------------------------------------------------------------------- #
+# ZeRO-1 optimizer-state sharding                                        #
+# --------------------------------------------------------------------- #
+def zero1_param_sharding(mesh: Mesh, leaf) -> NamedSharding:
+    """ZeRO-1 layout for one param-shaped leaf: dim 0 sharded over the
+    batch axes when divisible, replicated otherwise (small biases/scales
+    are not worth a ragged layout)."""
+    n = dp_size(mesh)
+    if (hasattr(leaf, "ndim") and leaf.ndim >= 1 and n > 1
+            and leaf.shape[0] % n == 0):
+        return NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+    return NamedSharding(mesh, P())
+
+
+def zero1_opt_shardings(mesh: Mesh, tx, opt_state, params):
+    """Sharding tree for the optimizer state under ZeRO-1: every
+    param-shaped moment gets ``zero1_param_sharding``; counts and other
+    non-param leaves replicate.  Returns None (with a warning) when the
+    optimizer state cannot be mapped (exotic wrappers) — the caller keeps
+    the replicated layout, which is correct, just not memory-sharded."""
+    import optax
+    from ..utils.logging import log
+    repl = NamedSharding(mesh, P())
+    try:
+        return optax.tree_map_params(
+            tx, lambda _s, p: zero1_param_sharding(mesh, p),
+            opt_state, params, transform_non_params=lambda _s: repl)
+    except Exception as e:
+        log.warning(
+            "shard_optimizer_state: could not map the optimizer state "
+            "(%s: %s); optimizer moments stay REPLICATED (correct, but "
+            "no ZeRO-1 memory saving)", type(e).__name__, e)
+        return None
+
+
+def zero1_update_shardings(mesh: Mesh, params):
+    """Sharding constraints for the update tree (param-shaped): partition
+    the update computation the same way the moments are stored."""
+    return jax.tree.map(lambda p: zero1_param_sharding(mesh, p), params)
+
+
+# --------------------------------------------------------------------- #
+# Wire accounting                                                        #
+# --------------------------------------------------------------------- #
+def wire_bytes_per_step(params, n: int, cfg: ExchangeConfig) -> Dict[str, Any]:
+    """Analytic per-device bytes-on-wire for one gradient exchange.
+
+    Ring-allreduce fp32 moves ``2*(N-1)/N * 4 * size`` bytes per device;
+    the two-phase compressed exchange moves ``2*(N-1)/N`` of the
+    compressed payload (int8: 1 byte/elem + 4/block scale overhead; bf16:
+    2 bytes/elem); sub-threshold leaves pay the fp32 rate in both columns.
+    ``compressed_ratio`` is the reduction over compressed leaves only —
+    the honest headline for "large leaves"."""
+    if n <= 1:
+        factor = 0.0
+    else:
+        factor = 2.0 * (n - 1) / n
+    base_total = comp_base = 0.0
+    exch_total = comp_exch = 0.0
+    n_comp = n_fp32 = 0
+    for leaf in jax.tree.leaves(params):
+        size = int(np.prod(leaf.shape))
+        fp32 = factor * 4.0 * size
+        base_total += fp32
+        if compressible(leaf, cfg):
+            n_comp += 1
+            if cfg.mode == "int8":
+                padded = size + ((-size) % (max(n, 1) * cfg.block))
+                payload = padded * 1.0 + (padded // cfg.block) * 4.0
+            else:  # bf16
+                payload = size * 2.0
+            b = factor * payload
+            exch_total += b
+            comp_base += fp32
+            comp_exch += b
+        else:
+            n_fp32 += 1
+            exch_total += fp32
+    ratio = base_total / exch_total if exch_total else 1.0
+    comp_ratio = comp_base / comp_exch if comp_exch else 1.0
+    return {
+        "mode": cfg.mode, "block": cfg.block, "devices": n,
+        "baseline_fp32_bytes_per_step": int(base_total),
+        "exchange_bytes_per_step": int(exch_total),
+        "compression_ratio": round(ratio, 3),
+        "compressed_ratio": round(comp_ratio, 3),
+        "compressed_leaves": n_comp, "fp32_leaves": n_fp32,
+    }

@@ -66,8 +66,22 @@ def read_checkpoint(filepath: str) -> Dict[str, Any]:
 
 
 def restore_state(payload: Dict[str, Any], template_state):
-    """Restore a TrainState pytree from a checkpoint payload."""
-    return flax.serialization.from_state_dict(template_state, payload["state"])
+    """Restore a TrainState pytree from a checkpoint payload.
+
+    Field-set drift is reconciled against the template: state fields the
+    checkpoint predates (e.g. ``residual``/``grad_accum`` from before
+    gradient compression existed) fall back to the template's fresh
+    values, and saved fields the template no longer carries (compression
+    turned off on resume) are dropped -- error-feedback residuals are
+    advisory state, safe to reset, unlike params/opt_state."""
+    state = payload["state"]
+    if isinstance(state, dict):
+        tmpl = flax.serialization.to_state_dict(template_state)
+        if isinstance(tmpl, dict):
+            state = {k: (tmpl[k] if tmpl[k] is None or state.get(k) is None
+                         else state[k])
+                     for k in tmpl}
+    return flax.serialization.from_state_dict(template_state, state)
 
 
 def restore_params(payload: Dict[str, Any], template_params):

@@ -83,6 +83,7 @@ class Profiler:
         self._tls = threading.local()
         self._lock = threading.Lock()
         self._trace_dir: Optional[str] = None
+        self._comms: Optional[Dict[str, Any]] = None
 
     def __getstate__(self):
         """Ship-able across processes (the Trainer fan-out pickles its
@@ -133,6 +134,24 @@ class Profiler:
             self._stats.setdefault(name, _SpanStat()).add(dt_s)
 
     # ------------------------------------------------------------------ #
+    # Comms accounting (bytes-on-wire; parallel/collectives.py)           #
+    # ------------------------------------------------------------------ #
+    def record_comms(self, per_step: Dict[str, Any]) -> None:
+        """Attach a per-step bytes-on-wire record for the gradient
+        exchange (``collectives.wire_bytes_per_step`` shape: baseline
+        fp32 bytes, exchange bytes, compression_ratio, ...).  Analytic,
+        not sampled — collective payload sizes are static per compiled
+        step, so the honest number is computed once at compile time."""
+        with self._lock:
+            self._comms = dict(per_step)
+
+    def comms(self) -> Optional[Dict[str, Any]]:
+        """The last recorded gradient-exchange wire accounting (None when
+        no compression-enabled trainer compiled against this profiler)."""
+        with self._lock:
+            return dict(self._comms) if self._comms is not None else None
+
+    # ------------------------------------------------------------------ #
     def summary(self) -> Dict[str, Dict[str, float]]:
         """name -> {count, total_s, mean_s, p50_s, p95_s, p99_s, max_s}.
 
@@ -170,11 +189,20 @@ class Profiler:
                 f"{s['mean_s'] * 1e3:>7.2f}ms {s['p50_s'] * 1e3:>7.2f}ms "
                 f"{s['p95_s'] * 1e3:>7.2f}ms {s['p99_s'] * 1e3:>7.2f}ms "
                 f"{s['max_s'] * 1e3:>7.2f}ms")
+        c = self.comms()
+        if c is not None:
+            lines.append(
+                f"grad exchange [{c.get('mode')}]: "
+                f"{c.get('exchange_bytes_per_step', 0) / 1e6:.2f} MB/step "
+                f"on wire vs {c.get('baseline_fp32_bytes_per_step', 0) / 1e6:.2f}"
+                f" MB fp32 ({c.get('compression_ratio')}x overall, "
+                f"{c.get('compressed_ratio')}x on compressed leaves)")
         return "\n".join(lines)
 
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._comms = None
 
     # ------------------------------------------------------------------ #
     # Device traces (TensorBoard / XProf)                                #

@@ -37,6 +37,45 @@ WORKER_PLATFORM_STASH = os.environ.pop("RLA_TPU_WORKER_PLATFORM", None)
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def cpu_mesh_subprocess():
+    """Run a python script in a SPAWNED subprocess whose backend comes up
+    with an 8-device virtual CPU mesh.
+
+    The in-process suite already forces 8 devices (module top), but some
+    tests must prove behavior under a CLEAN backend init — e.g. the
+    collectives suite's claim that an exchange compiles on a fresh
+    8-device mesh without inheriting this process's jax config.  jax
+    0.4.37 has no ``jax_num_cpu_devices`` config option, so the ONLY
+    lever is ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` set
+    in the child's env BEFORE its backend initializes (which is why this
+    is a subprocess, not a fixture-scoped config tweak).
+
+    Returns ``run(script, timeout=120) -> CompletedProcess`` (asserts
+    exit 0, stderr in the failure message)."""
+    import subprocess
+    import sys
+
+    def run(script: str, timeout: float = 120.0, env_extra=None):
+        env = dict(os.environ)
+        env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "JAX_PLATFORMS": "cpu",
+            # the child must not inherit fan-out / chaos state
+            "RLA_TPU_INSIDE_WORKER": "",
+        })
+        env.update(env_extra or {})
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+        assert proc.returncode == 0, (
+            f"cpu_mesh_subprocess script failed (rc {proc.returncode}):\n"
+            f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+        return proc
+
+    return run
+
+
 @pytest.fixture(autouse=True)
 def _chaos_leak_guard(request):
     """``RLA_TPU_CHAOS`` makes every spawned worker crash/hang/stall on

@@ -49,10 +49,16 @@ def test_probe_rejects_child_without_marker(monkeypatch):
     assert bench.probe_backend(timeout_s=30) is not None
 
 
-def test_dead_backend_emits_one_json_line_and_exit_2(monkeypatch,
-                                                     capsys):
-    """main() with a dead backend: exactly one parseable record,
-    exit code 2, and no bench ever ran."""
+def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
+                                                           capsys):
+    """main() with a dead backend: the death record comes FIRST, exit
+    code 2, no accelerator bench ever ran -- and the gradient-exchange
+    CPU fallback still lands one REAL metric line next to the death
+    record (all five earlier BENCH rounds contained no real number;
+    this pins the fix).  The fallback is faked here (the real
+    forced-CPU path is covered by test_collectives / the probe script
+    itself); its failure mode is also pinned: a broken fallback must
+    not mask the death record or the exit code."""
     monkeypatch.setattr(bench, "_PROBE_SRC", "raise SystemExit(1)")
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "mnist",
@@ -60,16 +66,31 @@ def test_dead_backend_emits_one_json_line_and_exit_2(monkeypatch,
     ran = []
     monkeypatch.setitem(bench.BENCHES, "mnist",
                         lambda: ran.append(1) or {})
+    monkeypatch.setattr(
+        bench, "bench_gradexchange",
+        lambda: {"metric": "gradexchange_int8_wire_bytes_reduction",
+                 "value": 3.9, "unit": "x", "vs_baseline": 0.98})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 2
     assert not ran
-    lines = [ln for ln in capsys.readouterr().out.splitlines()
-             if ln.strip()]
-    assert len(lines) == 1
-    rec = json.loads(lines[0])
-    assert rec["metric"] == "backend_probe"
-    assert rec["error"] == "backend unavailable"
+    lines = [json.loads(ln) for ln
+             in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 2
+    assert lines[0]["metric"] == "backend_probe"
+    assert lines[0]["error"] == "backend unavailable"
+    assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
+    assert "error" not in lines[1]
+
+    # fallback crash: death record + exit 2 survive, just no metric line
+    monkeypatch.setattr(bench, "bench_gradexchange",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(SystemExit) as e2:
+        bench.main()
+    assert e2.value.code == 2
+    lines2 = [json.loads(ln) for ln
+              in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines2) == 1 and lines2[0]["metric"] == "backend_probe"
 
 
 def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
@@ -140,6 +161,34 @@ def test_isolated_mode_survives_a_hung_bench(monkeypatch, capsys):
     by_metric = {r["metric"]: r for r in lines}
     assert by_metric["selftest-hang"]["error"] == "bench timed out"
     assert by_metric["selftest"]["value"] == 1
+
+
+def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
+                                                      capsys):
+    """Mid-run backend death in the DEFAULT (isolated) mode: the child's
+    death record passes through, later benches stop, exit code is 2 --
+    and the CPU gradexchange fallback still lands one real metric line
+    (pre-flight probe alone does not protect a backend that dies after
+    it passed)."""
+    monkeypatch.setenv("RLA_TPU_BENCH_SELFTEST", "1")
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "print('PROBE_OK 1.0 fake')")  # pre-flight passes
+    monkeypatch.setattr(
+        bench, "bench_gradexchange",
+        lambda: {"metric": "gradexchange_int8_wire_bytes_reduction",
+                 "value": 3.9, "unit": "x", "vs_baseline": 0.98})
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "selftest-dead,selftest",
+                         "--probe-timeout", "5"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    lines = [json.loads(ln) for ln
+             in capsys.readouterr().out.splitlines() if ln.strip()]
+    metrics = [r["metric"] for r in lines]
+    assert "gradexchange_int8_wire_bytes_reduction" in metrics
+    assert any(r.get("error") == "backend died mid-run" for r in lines)
+    assert "selftest" not in metrics  # nothing ran after the death
 
 
 def test_isolated_mode_passes_through_child_records(monkeypatch,

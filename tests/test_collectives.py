@@ -1,0 +1,444 @@
+"""Gradient-exchange layer (parallel/collectives.py): quantized allreduce
+exactness/error bounds, ZeRO-1 bit-identity, wire accounting, and the
+Trainer flags that surface both -- all on the suite's 8-device CPU mesh."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.parallel import collectives as C
+from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+
+from .utils import BlobsDataModule, BoringModel, LinearClassifier, \
+    boring_loaders
+
+pytestmark = pytest.mark.collectives
+
+
+def _lead_sharding(mesh):
+    return NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+
+
+def _put_stacked(mesh, tree):
+    lead = _lead_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), lead), tree)
+
+
+def _exchange_once(mesh, cfg, params, grads, residuals=None):
+    n = C.dp_size(mesh)
+    res = residuals if residuals is not None \
+        else _put_stacked(mesh, C.residual_zeros(params, n, cfg))
+    ex = jax.jit(C.build_exchange(mesh, cfg))
+    return ex(_put_stacked(mesh, grads), res)
+
+
+# --------------------------------------------------------------------- #
+# Pure quantization                                                      #
+# --------------------------------------------------------------------- #
+def test_quantize_blocks_roundtrip_error():
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.normal(size=(4096,)).astype(np.float32))
+    q, s = C.quantize_blocks(v, 256)
+    assert q.dtype == jnp.int8 and s.shape == (16,)
+    back = C.dequantize_blocks(q, s)
+    rel = float(jnp.linalg.norm(back - v) / jnp.linalg.norm(v))
+    assert rel < 1e-2
+    # all-zero blocks must not divide by zero
+    qz, sz = C.quantize_blocks(jnp.zeros((256,)), 256)
+    assert float(jnp.abs(C.dequantize_blocks(qz, sz)).max()) == 0.0
+
+
+def test_exchange_config_validates_mode():
+    with pytest.raises(ValueError, match="grad_compression"):
+        C.ExchangeConfig(mode="int4")
+    with pytest.raises(ValueError, match="block"):
+        C.ExchangeConfig(mode="int8", block=0)
+
+
+# --------------------------------------------------------------------- #
+# Exchange numerics on the 8-device mesh                                 #
+# --------------------------------------------------------------------- #
+def test_int8_exchange_single_step_error_bound():
+    """Acceptance bound: one int8 exchange of random grads lands within
+    1e-2 relative error of the true fp32 mean, per leaf."""
+    mesh = mesh_lib.build_mesh()
+    n = C.dp_size(mesh)
+    cfg = C.ExchangeConfig(mode="int8")
+    rng = np.random.default_rng(0)
+    params = {"w": np.zeros((512, 64), np.float32),
+              "b": np.zeros((7,), np.float32)}
+    grads = {"w": rng.normal(size=(n, 512, 64)).astype(np.float32),
+             "b": rng.normal(size=(n, 7)).astype(np.float32)}
+    out, new_res = _exchange_once(mesh, cfg, params, grads)
+    true = jax.tree.map(lambda a: a.mean(0), grads)
+    for key in ("w", "b"):
+        t = np.asarray(true[key])
+        rel = np.linalg.norm(np.asarray(out[key]) - t) / np.linalg.norm(t)
+        assert rel < 1e-2, f"{key}: rel err {rel}"
+    # sub-threshold leaf rides the fp32 psum: exact (up to psum rounding)
+    np.testing.assert_allclose(np.asarray(out["b"]), true["b"], rtol=1e-6)
+    # residuals: real buffers only for the compressed leaf
+    assert np.asarray(new_res["w"]).shape == (n, 512 * 64)
+    assert np.asarray(new_res["b"]).shape == (n, 1)
+    assert float(jnp.abs(new_res["b"]).max()) == 0.0
+    assert float(jnp.linalg.norm(new_res["w"])) > 0.0
+
+
+def test_error_feedback_reduces_bias_across_steps():
+    """Feeding the residual back must push the RUNNING MEAN of exchanged
+    grads toward the true mean -- the property that keeps SGD convergent
+    under lossy exchange."""
+    mesh = mesh_lib.build_mesh()
+    n = C.dp_size(mesh)
+    cfg = C.ExchangeConfig(mode="int8")
+    rng = np.random.default_rng(1)
+    params = {"w": np.zeros((256, 64), np.float32)}
+    grads = {"w": rng.normal(size=(n, 256, 64)).astype(np.float32)}
+    gd = _put_stacked(mesh, grads)
+    res = _put_stacked(mesh, C.residual_zeros(params, n, cfg))
+    ex = jax.jit(C.build_exchange(mesh, cfg))
+    true = grads["w"].mean(0)
+    outs = []
+    for _ in range(4):
+        out, res = ex(gd, res)
+        outs.append(np.asarray(out["w"]))
+    err1 = np.linalg.norm(outs[0] - true) / np.linalg.norm(true)
+    err4 = np.linalg.norm(np.mean(outs, 0) - true) / np.linalg.norm(true)
+    assert err4 < err1 * 0.75, (err1, err4)
+
+
+def test_bf16_exchange_error_bound():
+    mesh = mesh_lib.build_mesh()
+    n = C.dp_size(mesh)
+    cfg = C.ExchangeConfig(mode="bf16")
+    rng = np.random.default_rng(2)
+    params = {"w": np.zeros((512, 64), np.float32)}
+    grads = {"w": rng.normal(size=(n, 512, 64)).astype(np.float32)}
+    out, _ = _exchange_once(mesh, cfg, params, grads)
+    true = grads["w"].mean(0)
+    rel = np.linalg.norm(np.asarray(out["w"]) - true) / np.linalg.norm(true)
+    assert rel < 5e-3
+
+
+def test_wire_bytes_report():
+    params = {"w": np.zeros((512, 512), np.float32),   # compressed
+              "b": np.zeros((64,), np.float32)}        # fp32 path
+    r8 = C.wire_bytes_per_step(params, 8, C.ExchangeConfig(mode="int8"))
+    # acceptance: >= 3.5x on the large (compressed) leaves
+    assert r8["compressed_ratio"] >= 3.5
+    assert r8["compressed_leaves"] == 1 and r8["fp32_leaves"] == 1
+    assert r8["exchange_bytes_per_step"] < r8["baseline_fp32_bytes_per_step"]
+    rb = C.wire_bytes_per_step(params, 8, C.ExchangeConfig(mode="bf16"))
+    assert abs(rb["compressed_ratio"] - 2.0) < 1e-6
+    rn = C.wire_bytes_per_step(params, 8, C.ExchangeConfig(mode=None))
+    assert rn["compression_ratio"] == 1.0 and rn["compressed_leaves"] == 0
+
+
+def test_compression_rejects_model_parallel_mesh():
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=4, tensor=2))
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        C.validate_mesh_for_compression(mesh)
+    # and through the public Trainer surface
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir="/tmp/collectives_tp",
+                      accelerator=RayTPUAccelerator(num_workers=4, tensor=2),
+                      grad_compression="int8")
+    train, val = boring_loaders()
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        trainer.fit(BoringModel(), train, val)
+
+
+def test_trainer_rejects_unknown_compression_mode():
+    with pytest.raises(ValueError, match="grad_compression"):
+        Trainer(grad_compression="fp8")
+
+
+def test_compression_rejects_sharded_params(tmpdir):
+    """FSDP shards params; the compressed exchange would silently
+    all-gather them into every replica (plus full-size residuals),
+    destroying the memory savings -- must refuse loudly."""
+    from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
+                                                             synthetic_mnist)
+    x, y = synthetic_mnist(256, seed=0)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=64)
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmpdir),
+                      accelerator=RayTPUAccelerator(num_workers=8,
+                                                    use_fsdp=True),
+                      grad_compression="int8")
+    with pytest.raises(ValueError, match="replicated params"):
+        trainer.fit(MNISTClassifier({"layer_1": 64, "layer_2": 64}), loader)
+
+
+def test_profiler_reset_clears_comms():
+    from ray_lightning_accelerators_tpu.utils.profiler import Profiler
+    prof = Profiler()
+    prof.record_comms({"mode": "int8", "compression_ratio": 3.9})
+    assert prof.comms() is not None
+    prof.reset()
+    assert prof.comms() is None
+
+
+# --------------------------------------------------------------------- #
+# ZeRO-1                                                                 #
+# --------------------------------------------------------------------- #
+def _fit_linear(tmpdir, max_epochs=2, **kw):
+    trainer = Trainer(max_epochs=max_epochs, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmpdir),
+                      accelerator=RayTPUAccelerator(), **kw)
+    model = LinearClassifier()
+    dm = BlobsDataModule(n=256, batch_size=32)
+    trainer.fit(model, datamodule=dm)
+    return trainer, jax.device_get(trainer._state.params)
+
+
+def _adam_moment(opt_state, shape):
+    for leaf in jax.tree.leaves(opt_state):
+        if hasattr(leaf, "shape") and tuple(leaf.shape) == shape:
+            return leaf
+    raise AssertionError(f"no moment leaf of shape {shape}")
+
+
+def test_zero1_bit_identical_to_replicated(tmpdir):
+    """Acceptance: params after K steps with shard_optimizer_state=True
+    are BIT-identical to the replicated baseline (same seed/data), and
+    the Adam moments are genuinely 1/N-sharded on device."""
+    t0, p0 = _fit_linear(tmpdir.join("repl"))
+    t1, p1 = _fit_linear(tmpdir.join("zero1"), shard_optimizer_state=True)
+    for key in p0:
+        assert np.array_equal(np.asarray(p0[key]), np.asarray(p1[key])), key
+    n = C.dp_size(t1._mesh)
+    mu = _adam_moment(t1._state.opt_state, (32, 4))
+    assert not mu.sharding.is_fully_replicated
+    assert mu.addressable_shards[0].data.shape == (32 // n, 4)
+    # baseline moments replicated
+    mu0 = _adam_moment(t0._state.opt_state, (32, 4))
+    assert mu0.sharding.is_fully_replicated
+    # non-divisible leaves (bias moments, counts) stay replicated
+    b_mu = _adam_moment(t1._state.opt_state, (4,))
+    assert b_mu.sharding.is_fully_replicated
+
+
+def test_zero1_sharded_checkpoint_roundtrip(tmpdir):
+    """Acceptance: a sharded-opt-state checkpoint round-trips through
+    save_sharded/restore_sharded."""
+    from ray_lightning_accelerators_tpu.utils import \
+        sharded_checkpoint as sharded_lib
+
+    trainer, params = _fit_linear(tmpdir, shard_optimizer_state=True,
+                                  checkpoint_format="sharded")
+    path = os.path.join(str(tmpdir), "z1.ckpt")
+    trainer.save_checkpoint(path)
+    assert sharded_lib.is_sharded_checkpoint(path)
+    restored = sharded_lib.restore_sharded(path, template=trainer._state)
+    for a, b in zip(jax.tree.leaves(trainer._state),
+                    jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and a fresh trainer resumes training from it
+    trainer2 = Trainer(max_epochs=3, precision="f32", seed=0,
+                       enable_checkpointing=False,
+                       default_root_dir=str(tmpdir),
+                       accelerator=RayTPUAccelerator(),
+                       shard_optimizer_state=True)
+    model2 = LinearClassifier()
+    trainer2.fit(model2, datamodule=BlobsDataModule(n=256, batch_size=32),
+                 ckpt_path=path)
+    assert trainer2.current_epoch == 3
+
+
+# --------------------------------------------------------------------- #
+# Compression through the Trainer                                        #
+# --------------------------------------------------------------------- #
+def _fit_mnist(tmpdir, **kw):
+    from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
+                                                             synthetic_mnist)
+    x, y = synthetic_mnist(2048, seed=0)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=256, shuffle=True)
+    model = MNISTClassifier({"layer_1": 64, "layer_2": 64, "lr": 1e-3,
+                             "batch_size": 256})
+    trainer = Trainer(max_epochs=3, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmpdir),
+                      accelerator=RayTPUAccelerator(), **kw)
+    trainer.fit(model, loader)
+    return trainer
+
+
+def test_int8_training_tracks_fp32_loss(tmpdir):
+    """Acceptance: a short MNIST run under int8 exchange reaches a final
+    loss within 2% of the fp32 baseline, and the comms accounting
+    reports the >= 3.5x large-leaf wire reduction."""
+    from ray_lightning_accelerators_tpu.utils.profiler import Profiler
+
+    base = _fit_mnist(tmpdir.join("fp32"))
+    prof = Profiler()
+    comp = _fit_mnist(tmpdir.join("int8"), grad_compression="int8",
+                      profiler=prof)
+    l0 = base.callback_metrics["train_loss"]
+    l1 = comp.callback_metrics["train_loss"]
+    assert abs(l1 - l0) / l0 < 0.02, (l0, l1)
+    report = comp.comms_per_step
+    assert report is not None and report["mode"] == "int8"
+    assert report["compressed_ratio"] >= 3.5
+    # the two large MLP kernels (784x64, 64x64); the 64x10 head and the
+    # biases sit below min_compress_size and ride the fp32 path
+    assert report["compressed_leaves"] == 2
+    assert prof.comms() == report
+    assert f"{report['compressed_ratio']}x" in prof.describe()
+
+
+def test_compression_accumulation_applies_at_boundary(tmpdir):
+    """With accumulate_grad_batches=2 the exchange+update run only at
+    window boundaries: params after 3 micro-steps equal params after 2
+    (the odd step only accumulates), and differ after 4."""
+    def fit(max_steps):
+        trainer = Trainer(max_steps=max_steps, max_epochs=10,
+                          precision="f32", seed=0,
+                          enable_checkpointing=False,
+                          default_root_dir=str(tmpdir),
+                          accumulate_grad_batches=2,
+                          grad_compression="int8",
+                          accelerator=RayTPUAccelerator())
+        x = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+        loader = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+        model = BoringModel()
+        trainer.fit(model, loader)
+        return jax.device_get(trainer._state.params)
+
+    p2, p3, p4 = fit(2), fit(3), fit(4)
+    for key in ("kernel", "bias"):
+        np.testing.assert_array_equal(p2["layer"][key], p3["layer"][key])
+    assert not np.array_equal(p3["layer"]["kernel"], p4["layer"]["kernel"])
+
+
+def test_compression_accumulation_matches_multisteps_on_exact_path(tmpdir):
+    """BoringModel's leaves sit below min_compress_size, so the exchange
+    is a plain psum-mean (lossless): the compressed-path accumulator must
+    reproduce the MultiSteps baseline to float tolerance."""
+    def fit(**kw):
+        trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+                          enable_checkpointing=False,
+                          default_root_dir=str(tmpdir),
+                          accumulate_grad_batches=2,
+                          accelerator=RayTPUAccelerator(), **kw)
+        x = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+        loader = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+        model = BoringModel()
+        trainer.fit(model, loader)
+        return jax.device_get(trainer._state.params)
+
+    base = fit()
+    comp = fit(grad_compression="int8")
+    for key in ("kernel", "bias"):
+        np.testing.assert_allclose(comp["layer"][key], base["layer"][key],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_compression_composes_with_zero1_and_checkpoint(tmpdir):
+    """int8 exchange + ZeRO-1 + sharded checkpointing in one run: the
+    full flag-to-wire path, including residual state surviving a
+    save/restore."""
+    from ray_lightning_accelerators_tpu.utils import \
+        sharded_checkpoint as sharded_lib
+
+    trainer = _fit_mnist(tmpdir, grad_compression="int8",
+                         shard_optimizer_state=True,
+                         checkpoint_format="sharded")
+    assert trainer._state.residual is not None
+    path = os.path.join(str(tmpdir), "both.ckpt")
+    trainer.save_checkpoint(path)
+    restored = sharded_lib.restore_sharded(path, template=trainer._state)
+    for a, b in zip(jax.tree.leaves(trainer._state),
+                    jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_checkpoint_restores_into_compression_enabled_run(tmpdir):
+    """Turning grad_compression ON over a sharded checkpoint saved
+    WITHOUT it: orbax restore is structure-checked, so the trainer
+    retries with a stripped template and keeps fresh zero residuals
+    (the drift case docs/API.md promises for the sharded format)."""
+    trainer, params = _fit_linear(tmpdir, checkpoint_format="sharded")
+    path = os.path.join(str(tmpdir), "plain.ckpt")
+    trainer.save_checkpoint(path)
+    trainer2 = Trainer(max_epochs=3, precision="f32", seed=0,
+                       enable_checkpointing=False,
+                       default_root_dir=str(tmpdir),
+                       accelerator=RayTPUAccelerator(),
+                       grad_compression="int8")
+    model2 = LinearClassifier()
+    trainer2.fit(model2, datamodule=BlobsDataModule(n=256, batch_size=32),
+                 ckpt_path=path)
+    assert trainer2.current_epoch == 3
+    assert trainer2._state.residual is not None
+    # restored params really came from the checkpoint
+    assert trainer2.global_step > trainer.global_step
+
+
+def test_pickle_checkpoint_backcompat_without_residual_fields():
+    """A pickle checkpoint written before the residual/grad_accum fields
+    existed must restore into the new TrainState (fresh zeros), and a
+    residual-carrying checkpoint must restore with compression off
+    (residuals dropped)."""
+    import optax
+
+    from ray_lightning_accelerators_tpu.core.state import TrainState
+    from ray_lightning_accelerators_tpu.utils import checkpoint as ckpt_lib
+
+    params = {"w": jnp.ones((4, 2))}
+    tx = optax.sgd(0.1)
+    old_style = ckpt_lib.build_checkpoint(
+        TrainState.create(params, tx, jax.random.PRNGKey(0)), 1, 10)
+    # simulate the pre-PR payload: no residual/grad_accum keys at all
+    old_style["state"].pop("residual", None)
+    old_style["state"].pop("grad_accum", None)
+    template = TrainState.create(
+        params, tx, jax.random.PRNGKey(0),
+        residual={"w": jnp.zeros((8, 8))})
+    restored = ckpt_lib.restore_state(old_style, template)
+    assert np.asarray(restored.residual["w"]).shape == (8, 8)
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                  np.ones((4, 2)))
+    # reverse direction: saved residuals, compression now off
+    new_style = ckpt_lib.build_checkpoint(template, 1, 10)
+    plain = TrainState.create(params, tx, jax.random.PRNGKey(0))
+    restored2 = ckpt_lib.restore_state(new_style, plain)
+    assert restored2.residual is None
+
+
+def test_exchange_in_clean_subprocess(cpu_mesh_subprocess):
+    """The exchange must compile and hit its error bound under a FRESH
+    backend init with the forced-host-platform flag -- the conftest
+    fixture the collectives CI lane is built on."""
+    cpu_mesh_subprocess("""
+import jax, numpy as np, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from ray_lightning_accelerators_tpu.parallel import collectives as C
+from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+assert jax.device_count() == 8, jax.device_count()
+mesh = mesh_lib.build_mesh()
+n = C.dp_size(mesh)
+cfg = C.ExchangeConfig(mode="int8")
+rng = np.random.default_rng(0)
+params = {"w": np.zeros((256, 64), np.float32)}
+grads = {"w": rng.normal(size=(n, 256, 64)).astype(np.float32)}
+lead = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+gd = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), lead), grads)
+res = jax.tree.map(lambda a: jax.device_put(a, lead),
+                   C.residual_zeros(params, n, cfg))
+out, _ = jax.jit(C.build_exchange(mesh, cfg))(gd, res)
+true = grads["w"].mean(0)
+rel = np.linalg.norm(np.asarray(out["w"]) - true) / np.linalg.norm(true)
+assert rel < 1e-2, rel
+print("OK", rel)
+""")

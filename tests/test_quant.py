@@ -63,6 +63,63 @@ def test_supported_shapes():
     assert not quant.supported(8192, 768, 768)
 
 
+def test_supported_edge_shapes():
+    # m boundaries: single decode row is in, zero/negative rows are out,
+    # the VMEM bound is inclusive
+    assert quant.supported(1, 128, 128)
+    assert not quant.supported(0, 128, 128)
+    assert not quant.supported(-1, 128, 128)
+    assert quant.supported(quant._MAX_M, 128, 128)
+    assert not quant.supported(quant._MAX_M + 1, 128, 128)
+    # k: 128 is the smallest lane-tileable contraction; 96 is a multiple
+    # of 32 (sublane tile) but has no 128-lane block; 160 divides into
+    # neither
+    assert quant.supported(8, 128, 128)
+    assert not quant.supported(8, 96, 128)
+    assert not quant.supported(8, 160, 128)
+    # n: any multiple of a 128 block works, including non-powers of two
+    assert quant.supported(8, 128, 384)
+    assert not quant.supported(8, 128, 64)
+
+
+def test_int8_matmul_typed_errors():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(8, 128)).astype(np.float32))
+    wq = _rand_q8(rng, (128, 128))
+    scale = jnp.ones((128,), jnp.float32)
+    # contraction mismatch
+    with pytest.raises(ValueError, match="contraction dim"):
+        quant.int8_matmul(x, _rand_q8(rng, (256, 128)), scale,
+                          interpret=True)
+    # wrong scale layout
+    with pytest.raises(ValueError, match="per-out-channel"):
+        quant.int8_matmul(x, wq, jnp.ones((64,)), interpret=True)
+    # untileable n
+    with pytest.raises(ValueError, match="128-lane"):
+        quant.int8_matmul(x, _rand_q8(rng, (128, 100)),
+                          jnp.ones((100,)), interpret=True)
+    # untileable k (multiple of 32 but below the 128-lane block)
+    with pytest.raises(ValueError, match="not tileable"):
+        quant.int8_matmul(jnp.ones((8, 96)), _rand_q8(rng, (96, 128)),
+                          scale, interpret=True)
+    # VMEM row bound
+    with pytest.raises(ValueError, match="outside"):
+        quant.int8_matmul(jnp.ones((quant._MAX_M + 1, 128)), wq, scale,
+                          interpret=True)
+
+
+def test_int8_matmul_nt_typed_errors():
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(8, 128)).astype(np.float32))
+    with pytest.raises(ValueError, match="contraction dim"):
+        quant.int8_matmul_nt(x, _rand_q8(rng, (128, 256)), interpret=True)
+    with pytest.raises(ValueError, match="128-lane"):
+        quant.int8_matmul_nt(x, _rand_q8(rng, (100, 128)), interpret=True)
+    with pytest.raises(ValueError, match="outside"):
+        quant.int8_matmul_nt(jnp.ones((0, 128)), _rand_q8(rng, (128, 128)),
+                             interpret=True)
+
+
 def test_q8_decode_matches_dequant_decode():
     """The int8 kernels (forced interpret here) and the XLA dequant
     fallback are the same computation up to f32 accumulation order: the

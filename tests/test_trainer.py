@@ -138,6 +138,41 @@ def test_log_grad_norm_metric():
     assert trainer.callback_metrics.get("grad_norm", 0.0) > 0.0
 
 
+def test_log_grad_norm_is_micro_batch_norm_under_accumulation(tmp_path):
+    """Regression pin for the documented semantics: with
+    accumulate_grad_batches > 1 the logged "grad_norm" is the norm of
+    each MICRO-batch's gradients (what feeds the accumulator), not the
+    accumulated-window norm.  One window of 2 micro-steps: params are
+    untouched until the boundary, so the final metric must equal the
+    analytically computed norm of the SECOND micro-batch's grads at the
+    INITIAL params."""
+    import optax
+
+    from ray_lightning_accelerators_tpu import ArrayDataset, DataLoader
+    from ray_lightning_accelerators_tpu.utils.seed import rng_from_seed
+
+    x = np.random.default_rng(7).normal(size=(16, 32)).astype(np.float32)
+    loader = DataLoader(ArrayDataset(x), batch_size=8, shuffle=False)
+    model = BoringModel()
+    trainer = Trainer(max_epochs=1, precision="f32", seed=0,
+                      accumulate_grad_batches=2, log_grad_norm=True,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmp_path))
+    trainer.fit(model, loader)
+    logged = trainer.callback_metrics["grad_norm"]
+
+    init_rng, _ = jax.random.split(rng_from_seed(0))
+    p0 = model.init_params(init_rng)
+    batch2 = x[8:16]  # shuffle=False: second micro-batch of the window
+
+    def loss(params):
+        out = batch2 @ params["layer"]["kernel"] + params["layer"]["bias"]
+        return jnp.mean((out - 1.0) ** 2)
+
+    expected = float(optax.global_norm(jax.grad(loss)(p0)))
+    assert logged == pytest.approx(expected, rel=1e-4), (logged, expected)
+
+
 def test_val_check_interval_mid_epoch():
     from tests.utils import BoringModel, boring_loaders
     train, val = boring_loaders()  # 64 samples / batch 8 = 8 steps/epoch
