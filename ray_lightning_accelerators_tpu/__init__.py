@@ -16,6 +16,8 @@ from .core.trainer import Trainer
 from .data.datamodule import DataModule
 from .data.loader import (ArrayDataset, DataLoader, Dataset,
                           IterableDataset, RandomDataset, ShardedSampler)
+from .data.prefetch import (DevicePrefetcher, PrefetchIterator,
+                            prefetch_pipeline)
 from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.session import get_actor_rank, init_session, put_queue
 from .utils.profiler import Profiler, device_memory_stats
@@ -35,6 +37,7 @@ __all__ = [
     "Callback", "EarlyStopping", "ModelCheckpoint",
     "DataModule", "DataLoader", "Dataset", "IterableDataset", "ArrayDataset",
     "RandomDataset", "ShardedSampler",
+    "PrefetchIterator", "DevicePrefetcher", "prefetch_pipeline",
     "MeshConfig", "build_mesh",
     "get_actor_rank", "init_session", "put_queue",
     "Profiler", "device_memory_stats",

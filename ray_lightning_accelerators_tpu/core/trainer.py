@@ -26,6 +26,7 @@ times from one script (reference: README.md:34-36).
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -37,6 +38,7 @@ import optax
 
 from ..accelerators.base import Accelerator
 from ..accelerators.tpu import RayTPUAccelerator
+from ..data import prefetch as prefetch_lib
 from ..data.loader import DataLoader
 from ..parallel import mesh as mesh_lib
 from ..utils import checkpoint as ckpt_lib
@@ -79,6 +81,7 @@ class Trainer:
                  enable_progress_bar: bool = False,
                  profiler: Optional["Profiler"] = None,
                  cache_dataset_on_device: Any = "auto",
+                 prefetch_batches: int = 2,
                  worker_deadline_s: Optional[float] = None,
                  grad_compression: Optional[str] = None,
                  shard_optimizer_state: bool = False,
@@ -146,6 +149,22 @@ class Trainer:
         # device-resident dataset cache: "auto" caches array-backed datasets
         # up to _CACHE_MAX_BYTES; True forces (when eligible), False disables
         self.cache_dataset_on_device = cache_dataset_on_device
+        # async input pipeline (data/prefetch.py): host iteration + collate
+        # run on a background thread and the next N batches are eagerly
+        # device-placed, so step k's dispatch never waits on batch k's
+        # collate or H2D transfer.  0 = fully synchronous hot loop.  Batch
+        # order, tail-batch semantics, and every early-stop break are
+        # preserved exactly — the loss trajectory is bit-identical to
+        # prefetch_batches=0 (test-asserted).  Composes with
+        # grad_compression (host/H2D overlap is orthogonal to the gradient
+        # wire format) and the watchdog (heartbeats come from the worker
+        # dispatch loop, not the input thread); the device-cache scan path
+        # has no per-step host work, so prefetch is a no-op there.
+        if not isinstance(prefetch_batches, int) or prefetch_batches < 0:
+            raise ValueError(
+                f"prefetch_batches must be an int >= 0, got "
+                f"{prefetch_batches!r}")
+        self.prefetch_batches = prefetch_batches
         # per-attempt wall-clock budget for a fanned-out fit/eval body: a
         # rank busy past this is wedged -> reaped -> the attempt fails
         # retryably with WorkerWedged instead of hanging the driver (see
@@ -732,11 +751,13 @@ class Trainer:
             rows = perm[:nb * bs].astype(np.int32).reshape(nb, bs)
             if jax.process_count() > 1:
                 # a global (nb, bs) matrix is not eagerly row-indexable
-                # across processes; assemble each global row directly
+                # across processes; each global row is assembled from the
+                # local row at CONSUMPTION time (_put_index_row) -- under
+                # prefetch this generator runs on the producer thread,
+                # and placements must stay on the consumer thread so
+                # every process issues them in the same sequence
                 for i in range(nb):
-                    yield ("cached",
-                           jax.make_array_from_process_local_data(
-                               self._idx_row_sharding, rows[i]))
+                    yield ("cached_local", rows[i])
             else:
                 idx_mat = jax.device_put(rows)
                 for i in range(nb):
@@ -744,6 +765,25 @@ class Trainer:
         tail = self._tail_host_batch(loader, perm, nb)
         if tail is not None:
             yield ("host", tail)
+
+    def _put_index_row(self, row: np.ndarray):
+        """Assemble one global device index row from this process's local
+        row (the per-step analog of ``_put_index_matrix``)."""
+        return jax.make_array_from_process_local_data(
+            self._idx_row_sharding, row)
+
+    def _place_train_item(self, item):
+        """Device-place one fit-source item inside the prefetch pipeline
+        (runs on the CONSUMER thread, in stream order): host batches get
+        the batch sharding, local cached index rows are assembled into
+        global device rows; single-process cached rows are already
+        device-resident."""
+        kind, payload = item
+        if kind == "host":
+            payload = self._put_batch(payload)
+        elif kind == "cached_local":
+            kind, payload = "cached", self._put_index_row(payload)
+        return kind, payload
 
     def _put_batch(self, batch):
         """Ship one host batch to the mesh with the batch sharding.
@@ -1086,52 +1126,87 @@ class Trainer:
 
             if self._device_cache is not None:
                 source = self._cached_epoch_source(train_loader)
+            elif self.prefetch_batches:
+                # the pipeline's own data_fetch accounting replaces
+                # _iter_profiled: the fetch happens on the producer thread
+                source = (("host", b) for b in train_loader)
             else:
                 source = (("host", b)
                           for b in self._iter_profiled(train_loader))
-            for batch_idx, (kind, payload) in enumerate(source):
-                if (self.limit_train_batches is not None
-                        and batch_idx >= self.limit_train_batches):
-                    break
-                if kind == "cached":
-                    with self._span("train_step") as h:
-                        state, train_metrics = self._train_step_cached_fn(
-                            state, self._device_cache, payload)
-                        if h is not None:
-                            h.set(train_metrics)
+            pf = None
+            if self.prefetch_batches:
+                if self.limit_train_batches is not None:
+                    # bound the producer at the epoch's redefined length so
+                    # it never pulls (or places) past the limit break
+                    source = itertools.islice(source,
+                                              self.limit_train_batches)
+                pf = prefetch_lib.prefetch_pipeline(
+                    source, self.prefetch_batches, self._place_train_item,
+                    self.profiler, name="rla-prefetch-fit")
+                source = pf
+            try:
+                for batch_idx, (kind, payload) in enumerate(source):
+                    if (self.limit_train_batches is not None
+                            and batch_idx >= self.limit_train_batches):
+                        break
+                    if kind == "cached_local":
+                        # synchronous path (prefetch off): the pipeline's
+                        # _place_train_item does this conversion otherwise
+                        with self._span("h2d"):
+                            kind, payload = ("cached",
+                                             self._put_index_row(payload))
+                    if kind == "cached":
+                        with self._span("train_step") as h:
+                            state, train_metrics = \
+                                self._train_step_cached_fn(
+                                    state, self._device_cache, payload)
+                            if h is not None:
+                                h.set(train_metrics)
+                    else:
+                        if pf is None:
+                            with self._span("h2d"):
+                                batch = self._put_batch(payload)
+                        else:
+                            batch = payload  # placed by the pipeline
+                        with self._span("train_step") as h:
+                            state, train_metrics = self._train_step_fn(
+                                state, batch)
+                            if h is not None:
+                                h.set(train_metrics)
+                    self.global_step += 1
+                    self._state = state
+                    for c in self.callbacks:
+                        c.on_train_batch_end(self, module, train_metrics,
+                                             batch_idx)
+                    if self.global_step % self.log_every_n_steps == 0:
+                        self._log_now({f"{k}": float(v) for k, v in
+                                       jax.device_get(train_metrics).items()})
+                    if (self.val_check_interval
+                            and self._val_loader is not None
+                            and self.global_step % self.val_check_interval
+                            == 0):
+                        self._mid_epoch_validation(module)
+                        self._last_val_step = self.global_step
+                    if self.max_steps and self.global_step >= self.max_steps:
+                        self.should_stop = True
+                        break
+                    if self.max_time is not None and \
+                            time.perf_counter() - t0 >= self.max_time:
+                        self.should_stop = True
+                        break
                 else:
-                    with self._span("h2d"):
-                        batch = self._put_batch(payload)
-                    with self._span("train_step") as h:
-                        state, train_metrics = self._train_step_fn(state,
-                                                                   batch)
-                        if h is not None:
-                            h.set(train_metrics)
-                self.global_step += 1
-                self._state = state
-                for c in self.callbacks:
-                    c.on_train_batch_end(self, module, train_metrics, batch_idx)
-                if self.global_step % self.log_every_n_steps == 0:
-                    self._log_now({f"{k}": float(v) for k, v in
-                                   jax.device_get(train_metrics).items()})
-                if (self.val_check_interval
-                        and self._val_loader is not None
-                        and self.global_step % self.val_check_interval == 0):
-                    self._mid_epoch_validation(module)
-                    self._last_val_step = self.global_step
-                if self.max_steps and self.global_step >= self.max_steps:
-                    self.should_stop = True
-                    break
-                if self.max_time is not None and \
-                        time.perf_counter() - t0 >= self.max_time:
-                    self.should_stop = True
-                    break
-            else:
-                # epoch ran to the end of its loader (a max_steps break
-                # leaves the epoch incomplete for checkpoint accounting;
-                # limit_train_batches redefines the epoch, handled above by
-                # `break` too -- treat it as complete)
-                self.epochs_completed = self.current_epoch + 1
+                    # epoch ran to the end of its loader (a max_steps break
+                    # leaves the epoch incomplete for checkpoint accounting;
+                    # limit_train_batches redefines the epoch, handled above
+                    # by `break` too -- treat it as complete)
+                    self.epochs_completed = self.current_epoch + 1
+            finally:
+                # EVERY way out of the epoch (limit_train_batches,
+                # max_steps, max_time, mid-step exceptions) must stop and
+                # join the producer thread -- a leaked non-daemon thread
+                # hangs interpreter shutdown (conftest guards this)
+                if pf is not None:
+                    pf.close()
             if (self.limit_train_batches is not None
                     and not self.should_stop):
                 self.epochs_completed = self.current_epoch + 1
@@ -1283,12 +1358,31 @@ class Trainer:
         sums: Dict[str, float] = {}
         weights = 0.0
         device_metrics = []
-        for batch_idx, batch in enumerate(loader):
-            if limit is not None and batch_idx >= limit:
-                break
+
+        def place(batch):
+            # per-sample weight from the HOST batch, then device placement
             n = np.shape(jax.tree.leaves(batch)[0])[0]
-            batch = self._put_batch(batch)
-            device_metrics.append((n, step_fn(params, batch)))
+            return n, self._put_batch(batch)
+
+        source = iter(loader)
+        if limit is not None:
+            # bound the source (not a mid-loop break) so the pipeline
+            # never pulls or places batches past the limit
+            source = itertools.islice(source, limit)
+        pf = None
+        if self.prefetch_batches:
+            pf = prefetch_lib.prefetch_pipeline(
+                source, self.prefetch_batches, place, self.profiler,
+                name="rla-prefetch-eval")
+            source = pf
+        else:
+            source = map(place, source)
+        try:
+            for n, batch in source:
+                device_metrics.append((n, step_fn(params, batch)))
+        finally:
+            if pf is not None:
+                pf.close()
         for n, m in device_metrics:  # single host sync for the whole loop
             m = jax.device_get(m)
             for k, v in m.items():
@@ -1396,8 +1490,21 @@ class Trainer:
                 raise RuntimeError(
                     "predict() before fit(): module has no params")
             predict = jax.jit(module.predict_step)
-            return [jax.device_get(predict(params, batch))
-                    for batch in dataloaders]
+            source, pf = dataloaders, None
+            if self.prefetch_batches:
+                # host-side prefetch only: each rank's batches stay fully
+                # addressable (the jit places them), so overlapping the
+                # loader fetch is the whole win here
+                pf = prefetch_lib.PrefetchIterator(
+                    dataloaders, self.prefetch_batches,
+                    profiler=self.profiler, name="rla-prefetch-predict")
+                source = pf
+            try:
+                return [jax.device_get(predict(params, batch))
+                        for batch in source]
+            finally:
+                if pf is not None:
+                    pf.close()
         # single process: same mesh-aware path as every other stage -- the
         # batch lands with _batch_sharding (data-axis sharded on a
         # multi-device mesh) and runs through the compiled
@@ -1406,14 +1513,41 @@ class Trainer:
         params = self._state.params
         outs = []
         seen_n = None  # regular (already-compiled) batch size
-        for batch in dataloaders:
+
+        def place(batch):
+            # pad-to-divisor + device placement, sequential in stream
+            # order (seen_n threads the compiled batch size from the
+            # first regular batch into later tail pads)
+            nonlocal seen_n
             batch, true_n, padded_n = self._wrap_pad_batch(batch, seen_n)
             if true_n is None:
                 leaves = jax.tree.leaves(batch)
                 if leaves and np.ndim(leaves[0]):
                     seen_n = np.shape(leaves[0])[0]
-            out = jax.device_get(self._predict_step_fn(
-                params, self._put_batch(batch)))
+            return self._put_batch(batch), true_n, padded_n
+
+        source = iter(dataloaders)
+        pf = None
+        if self.prefetch_batches:
+            pf = prefetch_lib.prefetch_pipeline(
+                source, self.prefetch_batches, place, self.profiler,
+                name="rla-prefetch-predict")
+            source = pf
+        else:
+            source = map(place, source)
+        try:
+            outs = self._predict_consume(source, params)
+        finally:
+            if pf is not None:
+                pf.close()
+        return outs
+
+    def _predict_consume(self, source, params) -> List[Any]:
+        """Drain placed (batch, true_n, padded_n) triples through the
+        compiled predict step, stripping wrap-padding."""
+        outs: List[Any] = []
+        for batch, true_n, padded_n in source:
+            out = jax.device_get(self._predict_step_fn(params, batch))
             if true_n is not None:
                 # strip padding only when every ARRAY leaf carries the
                 # padded per-sample axis (mirroring the input-side

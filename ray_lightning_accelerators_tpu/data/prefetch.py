@@ -1,0 +1,315 @@
+"""Async input pipeline: background host prefetch + double-buffered
+device placement.
+
+The device-resident dataset cache (core/trainer.py:_build_device_cache)
+answers SURVEY.md §7.4 only for array-backed datasets under the HBM
+budget with the default collate.  Everything else — StreamingLMDataset,
+big vision sets, custom collates, all of eval/predict — runs a fully
+synchronous hot loop: collate on host, blocking device placement, then
+dispatch, so the accelerator idles through every host/H2D phase.  veScale
+(PAPERS.md) makes the same point for eager-style SPMD: the device queue
+must never drain.
+
+Two composable stages fix that without changing a single batch:
+
+- :class:`PrefetchIterator` — pulls the wrapped iterator (dataset
+  iteration + collate, i.e. the host-latency part) on ONE background
+  thread into a bounded depth-N queue.  A single producer and a FIFO
+  queue keep the order exactly the source's order; shutdown is explicit
+  (``close()`` stops and joins the thread — no leaked threads, enforced
+  suite-wide by a conftest guard) and a producer-side exception is
+  re-raised on the consumer with its original type and traceback, at
+  the position in the stream where it occurred.
+- :class:`DevicePrefetcher` — keeps up to N *device-placed* batches in
+  flight ahead of the consumer.  Placement runs on the CONSUMER thread
+  in stream order (``jax.device_put`` / ``make_array_from_
+  process_local_data`` are async dispatches: they return immediately
+  while the transfer proceeds), which multi-process placement requires —
+  every process must issue the same placements in the same sequence.
+  Step k's dispatch therefore never waits on batch k's H2D transfer:
+  that transfer was issued while step k-1 (or earlier) computed.
+
+``prefetch_pipeline`` composes the two; the Trainer wires it through
+fit/eval/predict behind ``Trainer(prefetch_batches=N)``.
+
+Profiler accounting (utils/profiler.py): per-step ``h2d_wait`` span
+(time the consumer waited for its next placed batch), a
+``prefetch_depth`` queue-depth gauge, and a ``prefetch_starved_steps``
+counter — steps that found the pipeline empty.  A starved run is
+input-bound: deeper prefetch or cheaper collate, not a faster model,
+is the lever.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from ..utils.logging import log
+
+# producer stop-check cadence while blocked on a full queue: close()
+# latency is bounded by ~2 polls
+_PUT_POLL_S = 0.05
+# consumer poll while blocked on an empty queue: each timeout re-checks
+# that the producer thread is still alive (a silently-dead producer must
+# not hang the consumer forever)
+_GET_POLL_S = 0.5
+
+# queue records: ("item", payload) | ("raise", exc) | ("end", None)
+_ITEM, _RAISE, _END = "item", "raise", "end"
+
+
+class PrefetchClosed(RuntimeError):
+    """Iteration attempted on a pipeline after ``close()``."""
+
+
+class PrefetchIterator:
+    """Iterate ``source`` on a background thread into a bounded queue.
+
+    Deterministic: one producer thread + one FIFO queue reproduce the
+    source's order exactly.  ``depth`` bounds host memory (at most
+    ``depth`` batches buffered) and bounds how far a stateful source
+    (e.g. a round-robin-sharded stream) runs ahead of consumption.
+
+    Exceptions raised by the source surface on the consumer at the
+    failing element's position in the stream, with their original type
+    and traceback.  ``close()`` (idempotent, also the context-manager
+    exit) stops and joins the thread; iteration past ``close()`` raises
+    :class:`PrefetchClosed`.
+    """
+
+    def __init__(self, source: Iterable[Any], depth: int,
+                 profiler=None, fetch_metric: str = "data_fetch",
+                 name: str = "rla-prefetch"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = iter(source)
+        self._profiler = profiler
+        self._fetch_metric = fetch_metric
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._closed = False
+        self._finished = False
+        # NON-daemon on purpose: a leaked producer is a bug (the conftest
+        # guard fails the test); every exit path must close() this
+        self._thread = threading.Thread(target=self._produce, name=name,
+                                        daemon=False)
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------ #
+    def _put(self, record) -> bool:
+        """Stop-aware blocking put; False when close() interrupted it."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(record, timeout=_PUT_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    break
+                if self._profiler is not None:
+                    self._profiler.observe(self._fetch_metric,
+                                           time.perf_counter() - t0)
+                if not self._put((_ITEM, item)):
+                    return
+            if not self._stop.is_set():
+                self._put((_END, None))
+        except BaseException as e:  # noqa: BLE001 - carried to consumer
+            self._put((_RAISE, e))
+
+    # -- consumer ------------------------------------------------------ #
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise PrefetchClosed("prefetch iterator used after close()")
+        if self._finished:
+            raise StopIteration
+        while True:
+            try:
+                kind, payload = self._queue.get(timeout=_GET_POLL_S)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # one last non-blocking drain: the producer may have
+                    # put its final record between the timeout and the
+                    # liveness check
+                    try:
+                        kind, payload = self._queue.get_nowait()
+                        break
+                    except queue.Empty:
+                        self._finished = True
+                        raise RuntimeError(
+                            "prefetch producer thread died without a "
+                            "final record") from None
+        if kind == _ITEM:
+            return payload
+        self._finished = True
+        self._thread.join()
+        if kind == _END:
+            raise StopIteration
+        raise payload  # original exception object: type + traceback kept
+
+    def qsize(self) -> int:
+        """Batches currently buffered (ready without blocking)."""
+        return self._queue.qsize()
+
+    # -- lifecycle ----------------------------------------------------- #
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop and join the producer.  Idempotent; safe mid-iteration
+        (the early-exit paths — limit_train_batches, max_steps,
+        max_time, exceptions — all land here via ``finally``)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:  # unblock a producer stuck in put() on a full queue
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=_PUT_POLL_S)
+        if self._thread.is_alive():  # pragma: no cover - defensive
+            log.warning("prefetch producer %s did not stop within %.1fs",
+                        self._thread.name, timeout)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class DevicePrefetcher:
+    """Keep up to ``depth`` device-placed batches in flight ahead of the
+    consumer (the double-buffer generalized to depth N).
+
+    Each ``__next__`` (1) blocks — timed as ``h2d_wait`` — only if no
+    placed batch is ready, (2) tops the ring back up by placing every
+    batch the host stage already has waiting (placement is an async
+    dispatch; the transfers overlap the consumer's compute), and
+    (3) returns the oldest placed batch.  Errors from the source or from
+    ``place_fn`` are stashed and re-raised exactly at their position in
+    the stream, so batches before a failure are still consumed and the
+    trainer's ``global_step`` stays consistent.
+    """
+
+    def __init__(self, inner, depth: int,
+                 place_fn: Optional[Callable[[Any], Any]] = None,
+                 profiler=None,
+                 wait_metric: str = "h2d_wait",
+                 depth_gauge: str = "prefetch_depth",
+                 starve_counter: str = "prefetch_starved_steps"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._inner = inner
+        self._iter = iter(inner)
+        self._depth = depth
+        self._place = place_fn
+        self._profiler = profiler
+        self._wait_metric = wait_metric
+        self._depth_gauge = depth_gauge
+        self._starve_counter = starve_counter
+        self._ring: collections.deque = collections.deque()
+        self._exhausted = False
+        self._pending_exc: Optional[BaseException] = None
+        self._started = False
+
+    def _advance(self) -> bool:
+        """Pull + place ONE batch into the ring.  Termination and errors
+        are stashed (not raised) so they surface in stream order."""
+        if self._exhausted or self._pending_exc is not None:
+            return False
+        try:
+            item = next(self._iter)
+            self._ring.append(item if self._place is None
+                              else self._place(item))
+            return True
+        except StopIteration:
+            self._exhausted = True
+        except BaseException as e:  # noqa: BLE001 - surfaced in order
+            self._pending_exc = e
+        return False
+
+    def _ready(self) -> bool:
+        """Does the host stage have a batch waiting (no blocking)?"""
+        qsize = getattr(self._inner, "qsize", None)
+        return qsize is not None and qsize() > 0
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        prof = self._profiler
+        t0 = time.perf_counter()
+        # the first batch of a stream inevitably waits (nothing was in
+        # flight yet) — that's warmup, not starvation
+        starved = self._started and not self._ring
+        if not self._ring:
+            self._advance()  # blocking pull
+        wait = time.perf_counter() - t0
+        # top up: issue placements for everything already collated, up to
+        # depth — these H2D transfers run while the consumer computes
+        while len(self._ring) < self._depth and self._ready():
+            if not self._advance():
+                break
+        if self._ring:
+            if prof is not None:
+                prof.observe(self._wait_metric, wait)
+                if starved:
+                    prof.incr(self._starve_counter)
+                # buffer remaining AFTER this batch is taken: 0 here means
+                # the next step is at risk of starving too
+                prof.gauge(self._depth_gauge,
+                           len(self._ring) - 1 + (self._inner.qsize()
+                                                  if hasattr(self._inner,
+                                                             "qsize")
+                                                  else 0))
+            self._started = True
+            return self._ring.popleft()
+        if self._pending_exc is not None:
+            exc, self._pending_exc = self._pending_exc, None
+            raise exc
+        raise StopIteration
+
+    def close(self, timeout: float = 5.0) -> None:
+        if isinstance(self._inner, PrefetchIterator):
+            self._inner.close(timeout=timeout)
+        else:
+            # plain iterators (generators) take no timeout; a bare
+            # iterable may have no close() at all
+            close = getattr(self._inner, "close", None)
+            if close is not None:
+                close()
+        self._ring.clear()
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def prefetch_pipeline(source: Iterable[Any], depth: int,
+                      place_fn: Optional[Callable[[Any], Any]] = None,
+                      profiler=None,
+                      name: str = "rla-prefetch") -> DevicePrefetcher:
+    """The full async input pipeline: host iteration + collate on a
+    background thread (:class:`PrefetchIterator`), device placement
+    double-buffered ``depth`` ahead (:class:`DevicePrefetcher`).
+    ``close()`` on the returned object stops and joins the thread."""
+    host = PrefetchIterator(source, depth, profiler=profiler, name=name)
+    return DevicePrefetcher(host, depth, place_fn, profiler=profiler)

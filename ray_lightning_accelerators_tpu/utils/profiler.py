@@ -84,6 +84,9 @@ class Profiler:
         self._lock = threading.Lock()
         self._trace_dir: Optional[str] = None
         self._comms: Optional[Dict[str, Any]] = None
+        self._counters: Dict[str, int] = {}
+        # gauge -> [count, sum, min, max, last]
+        self._gauges: Dict[str, List[float]] = {}
 
     def __getstate__(self):
         """Ship-able across processes (the Trainer fan-out pickles its
@@ -132,6 +135,44 @@ class Profiler:
         with-block on one thread."""
         with self._lock:
             self._stats.setdefault(name, _SpanStat()).add(dt_s)
+
+    # ------------------------------------------------------------------ #
+    # Counters & gauges (input-pipeline accounting; data/prefetch.py)     #
+    # ------------------------------------------------------------------ #
+    def incr(self, name: str, n: int = 1) -> None:
+        """Bump a monotonically-increasing counter.  The prefetch
+        pipeline counts ``prefetch_starved_steps`` — steps that found no
+        batch ready; a nonzero count means the run is input-bound."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauge(self, name: str, value: float) -> None:
+        """Sample an instantaneous level (e.g. ``prefetch_depth``, the
+        number of batches ready ahead of the consumer).  Tracks
+        count/mean/min/max/last."""
+        v = float(value)
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._gauges[name] = [1, v, v, v, v]
+            else:
+                g[0] += 1
+                g[1] += v
+                g[2] = min(g[2], v)
+                g[3] = max(g[3], v)
+                g[4] = v
+
+    def gauges(self) -> Dict[str, Dict[str, float]]:
+        """name -> {count, mean, min, max, last}."""
+        with self._lock:
+            items = {k: list(v) for k, v in self._gauges.items()}
+        return {k: {"count": int(c), "mean": s / max(c, 1), "min": lo,
+                    "max": hi, "last": last}
+                for k, (c, s, lo, hi, last) in items.items()}
 
     # ------------------------------------------------------------------ #
     # Comms accounting (bytes-on-wire; parallel/collectives.py)           #
@@ -189,6 +230,19 @@ class Profiler:
                 f"{s['mean_s'] * 1e3:>7.2f}ms {s['p50_s'] * 1e3:>7.2f}ms "
                 f"{s['p95_s'] * 1e3:>7.2f}ms {s['p99_s'] * 1e3:>7.2f}ms "
                 f"{s['max_s'] * 1e3:>7.2f}ms")
+        for name, n in sorted(self.counters().items()):
+            lines.append(f"counter {name:<32} {n:>7d}")
+        for name, g in sorted(self.gauges().items()):
+            lines.append(
+                f"gauge   {name:<32} last={g['last']:g} "
+                f"mean={g['mean']:.2f} min={g['min']:g} max={g['max']:g}")
+        starved = self.counters().get("prefetch_starved_steps", 0)
+        if starved:
+            steps = self.summary().get("h2d_wait", {}).get("count", 0)
+            lines.append(
+                f"input pipeline: {starved}/{steps} steps found the "
+                "prefetch queue empty — run is input-bound (raise "
+                "prefetch_batches or cheapen the host pipeline)")
         c = self.comms()
         if c is not None:
             lines.append(
@@ -203,6 +257,8 @@ class Profiler:
         with self._lock:
             self._stats.clear()
             self._comms = None
+            self._counters.clear()
+            self._gauges.clear()
 
     # ------------------------------------------------------------------ #
     # Device traces (TensorBoard / XProf)                                #

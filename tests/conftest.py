@@ -76,6 +76,39 @@ def cpu_mesh_subprocess():
     return run
 
 
+# long-lived service threads owned by third-party libraries (orbax's
+# async-checkpoint machinery keeps these for the process lifetime after
+# the first async save; they are joined at interpreter exit by the
+# library's own atexit hooks) -- not leaks a test can or should close
+_THIRD_PARTY_THREAD_PREFIXES = ("metadata_store", "base_pytree_ch",
+                                "ocdbt_", "orbax")
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """Fail any test that leaks a live NON-daemon thread (a leaked
+    prefetch producer would hang interpreter shutdown and silently
+    serialize every later test).  Prefetch threads are non-daemon BY
+    DESIGN so this guard has teeth: every exit path out of an epoch must
+    close() its pipeline.  Daemon threads (agent/queue/watchdog service
+    loops) and known third-party service threads are exempt."""
+    import threading
+
+    before = set(threading.enumerate())
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t not in before and t.is_alive() and not t.daemon
+              and not t.name.startswith(_THIRD_PARTY_THREAD_PREFIXES)]
+    for t in leaked:  # grace: a joining thread may be mid-exit
+        t.join(timeout=2.0)
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        f"{request.node.nodeid} leaked non-daemon thread(s) "
+        f"{[t.name for t in leaked]}; prefetch pipelines (and anything "
+        "else spawning non-daemon threads) must be close()d on every "
+        "exit path")
+
+
 @pytest.fixture(autouse=True)
 def _chaos_leak_guard(request):
     """``RLA_TPU_CHAOS`` makes every spawned worker crash/hang/stall on

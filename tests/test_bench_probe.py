@@ -51,14 +51,16 @@ def test_probe_rejects_child_without_marker(monkeypatch):
 
 def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
                                                            capsys):
-    """main() with a dead backend: the death record comes FIRST, exit
-    code 2, no accelerator bench ever ran -- and the gradient-exchange
-    CPU fallback still lands one REAL metric line next to the death
-    record (all five earlier BENCH rounds contained no real number;
-    this pins the fix).  The fallback is faked here (the real
-    forced-CPU path is covered by test_collectives / the probe script
-    itself); its failure mode is also pinned: a broken fallback must
-    not mask the death record or the exit code."""
+    """main() with a dead backend: the death record comes FIRST, no
+    accelerator bench ever ran -- and the CPU-mesh fallback benches
+    (gradexchange + input_pipeline) still land REAL metric lines next
+    to the death record, so the window exits 0 and the driver records
+    numbers (all five earlier BENCH rounds were rc=2 with zero real
+    numbers; this pins the fix).  The fallbacks are faked here (the
+    real forced-CPU paths are covered by test_collectives /
+    test_prefetch / the probe scripts); the failure mode is also
+    pinned: with EVERY fallback broken there is no real line, so rc=2
+    survives as the zero-numbers signal."""
     monkeypatch.setattr(bench, "_PROBE_SRC", "raise SystemExit(1)")
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "mnist",
@@ -70,33 +72,51 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_gradexchange",
         lambda: {"metric": "gradexchange_int8_wire_bytes_reduction",
                  "value": 3.9, "unit": "x", "vs_baseline": 0.98})
+    monkeypatch.setattr(
+        bench, "bench_input_pipeline",
+        lambda: {"metric": "input_pipeline_prefetch_speedup",
+                 "value": 1.8, "unit": "x", "vs_baseline": 1.2})
     with pytest.raises(SystemExit) as e:
         bench.main()
-    assert e.value.code == 2
+    assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 2
+    assert len(lines) == 3
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
-    assert "error" not in lines[1]
+    assert lines[2]["metric"] == "input_pipeline_prefetch_speedup"
+    assert "error" not in lines[1] and "error" not in lines[2]
 
-    # fallback crash: death record + exit 2 survive, just no metric line
+    # one fallback crashing must not take the other (or exit 0) down
     monkeypatch.setattr(bench, "bench_gradexchange",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e2:
         bench.main()
-    assert e2.value.code == 2
+    assert e2.value.code == 0
     lines2 = [json.loads(ln) for ln
               in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines2) == 1 and lines2[0]["metric"] == "backend_probe"
+    assert [r["metric"] for r in lines2] == [
+        "backend_probe", "input_pipeline_prefetch_speedup"]
+
+    # EVERY fallback crashed: death record survives, and rc=2 keeps
+    # meaning "this window produced zero real numbers"
+    monkeypatch.setattr(bench, "bench_input_pipeline",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(SystemExit) as e3:
+        bench.main()
+    assert e3.value.code == 2
+    lines3 = [json.loads(ln) for ln
+              in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines3) == 1 and lines3[0]["metric"] == "backend_probe"
 
 
 def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
                                                        capsys):
     """A bench raising a CERTAIN backend-death marker aborts the rest
-    with a machine-readable record (no probe needed)."""
+    with a machine-readable record (no probe needed), emits the CPU
+    fallbacks, and exits 0 because real metric lines landed."""
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "a,b",
                          "--probe-timeout", "0", "--no-isolate"])
@@ -107,13 +127,51 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
     ran = []
     monkeypatch.setitem(bench.BENCHES, "a", dead)
     monkeypatch.setitem(bench.BENCHES, "b", lambda: ran.append(1) or {})
+    monkeypatch.setattr(
+        bench, "bench_gradexchange",
+        lambda: {"metric": "gradexchange_int8_wire_bytes_reduction",
+                 "value": 3.9, "unit": "x", "vs_baseline": 0.98})
+    monkeypatch.setattr(
+        bench, "bench_input_pipeline",
+        lambda: {"metric": "input_pipeline_prefetch_speedup",
+                 "value": 1.8, "unit": "x", "vs_baseline": 1.2})
     with pytest.raises(SystemExit) as e:
         bench.main()
-    assert e.value.code == 2
+    assert e.value.code == 0
     assert not ran  # b never ran against the dead backend
-    rec = json.loads(capsys.readouterr().out.splitlines()[-1])
+    lines = [json.loads(ln) for ln
+             in capsys.readouterr().out.splitlines() if ln.strip()]
+    rec = lines[0]
     assert rec["error"] == "backend died mid-run"
     assert rec["failed_bench"] == "a"
+    assert [r["metric"] for r in lines[1:]] == [
+        "gradexchange_int8_wire_bytes_reduction",
+        "input_pipeline_prefetch_speedup"]
+
+    # an EARLIER genuinely-failed bench keeps the window at exit 1
+    # (death + fallbacks must not mask it)
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "plain,a,b",
+                         "--probe-timeout", "0", "--no-isolate"])
+    monkeypatch.setitem(bench.BENCHES, "plain",
+                        lambda: (_ for _ in ()).throw(RuntimeError("oops")))
+    with pytest.raises(SystemExit) as e2:
+        bench.main()
+    assert e2.value.code == 1
+    capsys.readouterr()
+
+    # isolated-mode CHILDREN report a bare rc=2 instead (the parent
+    # emits the fallbacks once per window)
+    monkeypatch.setenv("RLA_TPU_BENCH_CHILD", "1")
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--benches", "a,b",
+                         "--probe-timeout", "0", "--no-isolate"])
+    with pytest.raises(SystemExit) as e3:
+        bench.main()
+    assert e3.value.code == 2
+    lines3 = [json.loads(ln) for ln
+              in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines3) == 1  # death record only; no fallback in the child
 
 
 def test_suspect_marker_with_probe_disabled_continues(monkeypatch,
@@ -166,10 +224,10 @@ def test_isolated_mode_survives_a_hung_bench(monkeypatch, capsys):
 def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
                                                       capsys):
     """Mid-run backend death in the DEFAULT (isolated) mode: the child's
-    death record passes through, later benches stop, exit code is 2 --
-    and the CPU gradexchange fallback still lands one real metric line
-    (pre-flight probe alone does not protect a backend that dies after
-    it passed)."""
+    death record passes through, later benches stop -- and the CPU-mesh
+    fallbacks still land real metric lines, so the window exits 0 and
+    the driver records numbers (pre-flight probe alone does not protect
+    a backend that dies after it passed)."""
     monkeypatch.setenv("RLA_TPU_BENCH_SELFTEST", "1")
     monkeypatch.setattr(bench, "_PROBE_SRC",
                         "print('PROBE_OK 1.0 fake')")  # pre-flight passes
@@ -177,16 +235,21 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_gradexchange",
         lambda: {"metric": "gradexchange_int8_wire_bytes_reduction",
                  "value": 3.9, "unit": "x", "vs_baseline": 0.98})
+    monkeypatch.setattr(
+        bench, "bench_input_pipeline",
+        lambda: {"metric": "input_pipeline_prefetch_speedup",
+                 "value": 1.8, "unit": "x", "vs_baseline": 1.2})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
     with pytest.raises(SystemExit) as e:
         bench.main()
-    assert e.value.code == 2
+    assert e.value.code == 0  # fallback metrics landed next to the record
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
     metrics = [r["metric"] for r in lines]
     assert "gradexchange_int8_wire_bytes_reduction" in metrics
+    assert "input_pipeline_prefetch_speedup" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

@@ -1,0 +1,371 @@
+"""Async input pipeline (data/prefetch.py + Trainer(prefetch_batches)):
+order determinism, bounded depth, clean shutdown, typed error
+propagation, IterableDataset round-robin preservation, and the
+acceptance bar — train-loss BIT-IDENTITY between prefetch on/off over a
+multi-step MNIST run (the pipeline changes where host work runs, never
+what runs)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.core.callbacks import Callback
+from ray_lightning_accelerators_tpu.data.loader import (IterableDataset,
+                                                        default_collate)
+from ray_lightning_accelerators_tpu.data.prefetch import (DevicePrefetcher,
+                                                          PrefetchClosed,
+                                                          PrefetchIterator,
+                                                          prefetch_pipeline)
+from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
+                                                         synthetic_mnist)
+from ray_lightning_accelerators_tpu.utils.profiler import Profiler
+
+from .utils import BoringModel, boring_loaders
+
+pytestmark = pytest.mark.prefetch
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("rla-prefetch") and t.is_alive()]
+
+
+# --------------------------------------------------------------------- #
+# PrefetchIterator / DevicePrefetcher unit behavior                      #
+# --------------------------------------------------------------------- #
+def test_order_preserved_and_thread_joined():
+    it = PrefetchIterator(iter(range(100)), depth=3)
+    assert list(it) == list(range(100))
+    assert not _prefetch_threads()  # exhaustion joins the producer
+
+
+def test_depth_bounds_producer_runahead():
+    pulled = []
+
+    def source():
+        for i in range(50):
+            pulled.append(i)
+            yield i
+
+    it = PrefetchIterator(source(), depth=3)
+    try:
+        consumed = 0
+        for v in it:
+            time.sleep(0.01)  # slow consumer: the producer races ahead
+            consumed += 1
+            # at most depth queued + 1 in the producer's hand
+            assert len(pulled) <= consumed + 3 + 1
+            if consumed == 20:
+                break
+    finally:
+        it.close()
+    assert not _prefetch_threads()
+
+
+def test_close_mid_iteration_is_idempotent_and_final():
+    it = PrefetchIterator(iter(range(1000)), depth=2)
+    assert next(it) == 0
+    it.close()
+    it.close()  # idempotent
+    assert not _prefetch_threads()
+    with pytest.raises(PrefetchClosed):
+        next(it)
+
+
+def test_worker_exception_is_typed_and_in_order():
+    def source():
+        yield from (0, 1)
+        raise ValueError("collate exploded")
+
+    it = PrefetchIterator(source(), depth=4)
+    assert next(it) == 0
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="collate exploded"):
+        next(it)  # ORIGINAL type + message, not a queue timeout
+    assert not _prefetch_threads()
+
+
+def test_device_prefetcher_place_error_surfaces_at_its_position():
+    def place(i):
+        if i == 3:
+            raise RuntimeError("bad placement")
+        return i * 10
+
+    pipe = prefetch_pipeline(iter(range(6)), depth=4, place_fn=place)
+    try:
+        assert [next(pipe) for _ in range(3)] == [0, 10, 20]
+        with pytest.raises(RuntimeError, match="bad placement"):
+            next(pipe)  # items 0..2 consumed FIRST, then the failure
+    finally:
+        pipe.close()
+    assert not _prefetch_threads()
+
+
+def test_device_prefetcher_runs_ahead_of_consumer():
+    placed = []
+    pipe = prefetch_pipeline(iter(range(10)), depth=3,
+                             place_fn=lambda i: placed.append(i) or i)
+    try:
+        got = [next(pipe) for _ in range(3)]
+        time.sleep(0.3)  # let the host stage fill its queue
+        next(pipe)
+        # after 4 consumed, placement has been issued past the consumer
+        assert got == [0, 1, 2] and len(placed) >= 5
+    finally:
+        pipe.close()
+
+
+def test_device_prefetcher_close_handles_plain_iterators():
+    # direct construction over a generator (no close-with-timeout, and
+    # bare iterables with no close at all) must shut down cleanly
+    d = DevicePrefetcher((i for i in range(5)), depth=2)
+    assert next(d) == 0
+    d.close()
+    with DevicePrefetcher(iter([1, 2, 3]), depth=2) as d2:
+        assert next(d2) == 1
+
+
+def test_depth_validation():
+    with pytest.raises(ValueError, match="depth"):
+        PrefetchIterator(iter(()), depth=0)
+    with pytest.raises(ValueError, match="prefetch_batches"):
+        Trainer(prefetch_batches=-1)
+
+
+# --------------------------------------------------------------------- #
+# IterableDataset round-robin sharding (regression)                      #
+# --------------------------------------------------------------------- #
+class _EpochStream(IterableDataset):
+    """Deterministic epoch-reshuffled stream of scalar rows."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        order = np.random.default_rng((99, self.epoch)).permutation(self.n)
+        for i in order:
+            yield np.asarray([i], np.float32)
+
+
+def test_iterable_round_robin_shards_survive_prefetch():
+    """Prefetch must reproduce the EXACT per-rank interleaved slices the
+    unprefetched loader yields — per epoch, including set_epoch
+    reshuffles — and the ranks must stay disjoint and cover the
+    stream."""
+    n, replicas, bs = 48, 2, 4
+    for epoch in (0, 1):
+        per_rank_plain, per_rank_pref = [], []
+        for rank in range(replicas):
+            def batches(prefetch: bool):
+                ds = _EpochStream(n)
+                loader = DataLoader(ds, batch_size=bs)
+                loader._inject_sampler(num_replicas=replicas, rank=rank,
+                                       shuffle=False)
+                loader.set_epoch(epoch)
+                if not prefetch:
+                    return list(loader)
+                it = PrefetchIterator(iter(loader), depth=2)
+                try:
+                    return list(it)
+                finally:
+                    it.close()
+
+            plain, pref = batches(False), batches(True)
+            assert len(plain) == len(pref) > 0
+            for a, b in zip(plain, pref):
+                np.testing.assert_array_equal(a, b)  # identical order
+            per_rank_plain.append(np.concatenate(plain).ravel())
+            per_rank_pref.append(np.concatenate(pref).ravel())
+        flat = np.concatenate(per_rank_pref)
+        assert len(set(flat.tolist())) == len(flat)  # disjoint shards
+        # together the ranks cover every complete block of the stream
+        covered = sorted(int(v) for v in flat)
+        expected = sorted(
+            int(v) for v in
+            np.random.default_rng((99, epoch)).permutation(n)[
+                :len(flat)])
+        assert covered == expected
+    # epochs genuinely reshuffled (set_epoch reached the stream)
+    assert not np.array_equal(
+        np.random.default_rng((99, 0)).permutation(n),
+        np.random.default_rng((99, 1)).permutation(n))
+    assert not _prefetch_threads()
+
+
+# --------------------------------------------------------------------- #
+# Trainer integration                                                    #
+# --------------------------------------------------------------------- #
+class _LossTrace(Callback):
+    def __init__(self, key: str = "ptl/train_loss"):
+        self.key = key
+        self.losses = []
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+        self.losses.append(float(jax.device_get(metrics[self.key])))
+
+
+def _mnist_fit(prefetch: int, **kwargs):
+    x, y = synthetic_mnist(64 * 6, seed=0)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=64, shuffle=True)
+    model = MNISTClassifier({"layer_1": 32, "layer_2": 32, "lr": 1e-3,
+                             "batch_size": 64})
+    trace = _LossTrace()
+    trainer = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0,
+                      cache_dataset_on_device=False,
+                      prefetch_batches=prefetch, callbacks=[trace],
+                      **kwargs)
+    trainer.fit(model, loader)
+    return trainer, trace.losses
+
+
+def test_train_loss_bit_identical_prefetch_on_off():
+    """The acceptance bar: a multi-step MNIST run produces the EXACT
+    same loss trajectory with prefetch 0 and 2 — the pipeline moves
+    host work, it never changes batch content, order, or math."""
+    t0, losses0 = _mnist_fit(0)
+    t2, losses2 = _mnist_fit(2)
+    assert t0.global_step == t2.global_step == 12
+    assert len(losses0) == len(losses2) == 12
+    assert losses0 == losses2  # bit-identical, not allclose
+    assert not _prefetch_threads()
+
+
+def test_early_stops_join_thread_and_match_unprefetched():
+    # limit_train_batches redefines the epoch
+    t0, l0 = _mnist_fit(0, limit_train_batches=3)
+    t2, l2 = _mnist_fit(2, limit_train_batches=3)
+    assert t0.global_step == t2.global_step == 6
+    assert l0 == l2
+    # max_steps breaks mid-epoch; the producer must still be joined
+    t0, l0 = _mnist_fit(0, max_steps=4)
+    t2, l2 = _mnist_fit(2, max_steps=4)
+    assert t0.global_step == t2.global_step == 4
+    assert l0 == l2
+    assert not _prefetch_threads()
+
+
+class _PoisonDataset(ArrayDataset):
+    """Raises on one specific sample index — mid-epoch, after the
+    example-batch probe."""
+
+    def __init__(self, *arrays, poison_idx: int):
+        super().__init__(*arrays)
+        self.poison_idx = poison_idx
+
+    def __getitem__(self, idx):
+        if idx == self.poison_idx:
+            raise ValueError("poisoned sample 42")
+        return super().__getitem__(idx)
+
+    def _native_arrays(self):
+        return None  # force the host-fed python path
+
+
+def test_mid_epoch_error_surfaces_typed_at_the_consuming_step():
+    """A dataset failure at batch k surfaces as the ORIGINAL error (not
+    a queue timeout / RuntimeError wrapper) and the trainer has
+    consumed exactly k steps — identical to the unprefetched loop."""
+    bs, n = 8, 64
+    x = np.random.default_rng(0).standard_normal((n, 32)).astype(np.float32)
+    steps = {}
+    for prefetch in (0, 2):
+        ds = _PoisonDataset(x, poison_idx=3 * bs)  # first sample of batch 3
+        loader = DataLoader(ds, batch_size=bs, shuffle=False)
+        trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                          precision="f32", enable_checkpointing=False,
+                          log_every_n_steps=10 ** 9, seed=0,
+                          cache_dataset_on_device=False,
+                          prefetch_batches=prefetch)
+        with pytest.raises(ValueError, match="poisoned sample 42"):
+            trainer.fit(BoringModel(), loader)
+        steps[prefetch] = trainer.global_step
+    assert steps[0] == steps[2] == 3  # batches 0..2 completed, then raise
+    assert not _prefetch_threads()  # the finally joined the producer
+
+
+def test_eval_and_predict_prefetch_parity():
+    x = np.random.default_rng(3).standard_normal((44, 32)).astype(np.float32)
+    model0 = BoringModel()
+    model0.params = model0.init_params(jax.random.PRNGKey(0))
+    results = {}
+    for prefetch in (0, 2):
+        trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                          precision="f32", enable_checkpointing=False,
+                          seed=0, prefetch_batches=prefetch)
+        val = DataLoader(ArrayDataset(x), batch_size=8)
+        metrics = trainer.validate(model0, val)[0]
+        # drop_last=False leaves a ragged 4-sample tail: the pad/strip
+        # path must behave identically under prefetch
+        pred_loader = DataLoader(ArrayDataset(x), batch_size=8,
+                                 drop_last=False)
+        preds = trainer.predict(model0, pred_loader)
+        results[prefetch] = (metrics, preds)
+    m0, p0 = results[0]
+    m2, p2 = results[2]
+    assert m0 == m2
+    assert len(p0) == len(p2)
+    for a, b in zip(p0, p2):
+        np.testing.assert_array_equal(a, b)
+    assert sum(len(p) for p in p2) == len(x)  # tail stripped, not padded
+    assert not _prefetch_threads()
+
+
+def test_profiler_input_pipeline_accounting():
+    """prefetch runs record h2d_wait spans, a prefetch_depth gauge and a
+    starvation counter; describe() reports them."""
+    prof = Profiler()
+    x, y = synthetic_mnist(64 * 4, seed=0)
+
+    def slow_collate(samples):
+        time.sleep(0.02)  # input-bound on purpose: starvation must fire
+        return default_collate(samples)
+
+    loader = DataLoader(ArrayDataset(x, y), batch_size=64, shuffle=False,
+                        collate_fn=slow_collate)
+    trainer = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0, profiler=prof,
+                      prefetch_batches=2)
+    trainer.fit(MNISTClassifier({"layer_1": 16, "layer_2": 16}), loader)
+    s = prof.summary()
+    assert s["h2d_wait"]["count"] == trainer.global_step == 8
+    assert "h2d" not in s  # placement moved into the pipeline
+    assert s["data_fetch"]["count"] >= trainer.global_step  # producer-side
+    gauges = prof.gauges()
+    assert gauges["prefetch_depth"]["count"] == trainer.global_step
+    assert gauges["prefetch_depth"]["max"] <= 2 * 2 - 1
+    starved = prof.counters()["prefetch_starved_steps"]
+    assert starved >= 1  # the loader IS slower than the model
+    text = prof.describe()
+    assert "prefetch_starved_steps" in text
+    assert "prefetch_depth" in text
+    assert "input-bound" in text
+    # reset clears the new accounting too
+    prof.reset()
+    assert prof.counters() == {} and prof.gauges() == {}
+
+
+def test_prefetch_zero_keeps_the_synchronous_span_shape():
+    prof = Profiler()
+    train, val = boring_loaders()
+    trainer = Trainer(max_epochs=1, accelerator=RayTPUAccelerator(),
+                      precision="f32", enable_checkpointing=False,
+                      log_every_n_steps=10 ** 9, seed=0, profiler=prof,
+                      cache_dataset_on_device=False, prefetch_batches=0)
+    trainer.fit(BoringModel(), train, val)
+    s = prof.summary()
+    assert s["h2d"]["count"] == trainer.global_step > 0
+    assert "h2d_wait" not in s
+    assert prof.counters() == {}
+    assert not _prefetch_threads()

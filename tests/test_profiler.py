@@ -78,13 +78,15 @@ def test_sync_span_blocks_on_device_outputs():
 
 
 def test_trainer_profiler_integration():
-    # host-fed path (cache off): fetch/h2d/step spans per batch
+    # SYNCHRONOUS host-fed path (cache off, prefetch off): fetch/h2d/step
+    # spans per batch.  The async-pipeline span shape (h2d_wait /
+    # prefetch_depth / starvation) is pinned in test_prefetch.py.
     prof = Profiler()
     train, val = boring_loaders()
     trainer = Trainer(max_epochs=2, accelerator=RayTPUAccelerator(),
                       precision="f32", enable_checkpointing=False,
                       profiler=prof, log_every_n_steps=10 ** 9, seed=0,
-                      cache_dataset_on_device=False)
+                      cache_dataset_on_device=False, prefetch_batches=0)
     trainer.fit(BoringModel(), train, val)
     s = prof.summary()
     assert s["train_step"]["count"] == trainer.global_step > 0
