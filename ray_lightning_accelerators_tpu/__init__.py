@@ -19,6 +19,8 @@ from .data.loader import (ArrayDataset, DataLoader, Dataset,
 from .data.prefetch import (DevicePrefetcher, PrefetchIterator,
                             prefetch_pipeline)
 from .parallel.mesh import MeshConfig, build_mesh
+from .runtime.elastic import ElasticResizeError, ElasticRunner
+from .runtime.preemption import Preempted, PreemptionNotice, get_notice
 from .runtime.session import get_actor_rank, init_session, put_queue
 from .utils.profiler import Profiler, device_memory_stats
 from . import models  # lazy family exports (models/__init__.py PEP 562)
@@ -39,6 +41,8 @@ __all__ = [
     "RandomDataset", "ShardedSampler",
     "PrefetchIterator", "DevicePrefetcher", "prefetch_pipeline",
     "MeshConfig", "build_mesh",
+    "ElasticRunner", "ElasticResizeError",
+    "Preempted", "PreemptionNotice", "get_notice",
     "get_actor_rank", "init_session", "put_queue",
     "Profiler", "device_memory_stats",
     "models", "schedules",

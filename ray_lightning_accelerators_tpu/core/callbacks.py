@@ -116,7 +116,8 @@ class ModelCheckpoint(Callback):
     def __init__(self, dirpath: Optional[str] = None, monitor: Optional[str] = "val_loss",
                  mode: str = "min", save_top_k: int = 1, save_last: bool = False,
                  filename: str = "epoch={epoch}-step={step}.ckpt",
-                 every_n_epochs: int = 1):
+                 every_n_epochs: int = 1,
+                 keep_last_k: Optional[int] = None):
         self.dirpath = dirpath
         self.monitor = monitor
         self.mode = mode
@@ -124,6 +125,14 @@ class ModelCheckpoint(Callback):
         self.save_last = save_last
         self.filename = filename
         self.every_n_epochs = max(1, every_n_epochs)
+        # retention GC over the WHOLE dirpath (utils/checkpoint
+        # .prune_checkpoints): emergency/preemption checkpoints and older
+        # runs' leftovers accumulate outside this callback's top-k
+        # bookkeeping; keep_last_k bounds the disk footprint while never
+        # deleting the only verified resume anchor.  None = no GC.
+        if keep_last_k is not None and keep_last_k < 1:
+            raise ValueError(f"keep_last_k must be >= 1, got {keep_last_k}")
+        self.keep_last_k = keep_last_k
         self._is_better, self.best_model_score = _mode_ops(mode)
         self.best_model_path: str = ""
         self.last_model_path: str = ""
@@ -149,6 +158,22 @@ class ModelCheckpoint(Callback):
         # (rename landing mid-rmtree) remove_checkpoint re-sweeps.
         remove_checkpoint(path)
 
+    def _prune(self) -> None:
+        """``keep_last_k`` retention GC (utils/checkpoint
+        .prune_checkpoints): process 0 only, with every path this
+        callback still tracks (top-k snapshots, best, last) protected."""
+        if self.keep_last_k is None or self.dirpath is None:
+            return
+        import jax
+
+        from ..utils import checkpoint as ckpt_lib
+        if jax.process_index() != 0:
+            return
+        protect = [self.best_model_path, self.last_model_path]
+        protect += [p for _score, p in self._saved]
+        ckpt_lib.prune_checkpoints(self.dirpath, self.keep_last_k,
+                                   protect=protect)
+
     def on_validation_end(self, trainer, module) -> None:
         if trainer.sanity_checking or not trainer.fitting or self.save_top_k == 0:
             return
@@ -164,6 +189,7 @@ class ModelCheckpoint(Callback):
                     _, evicted = self._saved.pop(0)
                     self._remove(evicted)
             self.best_model_path = path
+            self._prune()
             return
         current = trainer.callback_metrics.get(self.monitor)
         if current is None:
@@ -184,11 +210,13 @@ class ModelCheckpoint(Callback):
             if self._is_better(current, self.best_model_score):
                 self.best_model_score = current
                 self.best_model_path = path
+            self._prune()
 
     def on_fit_end(self, trainer, module) -> None:
         if self.save_last:
             self.last_model_path = os.path.join(self.dirpath, "last.ckpt")
             trainer.save_checkpoint(self.last_model_path)
+            self._prune()
 
     def state_dict(self) -> Dict[str, Any]:
         return {"best_model_score": self.best_model_score,

@@ -208,6 +208,14 @@ class Trainer:
         # death-record shape, runtime/watchdog.stall_record); None while
         # no supervised run has failed
         self.last_stall_diagnosis: Optional[Dict[str, Any]] = None
+        # preemption drain (runtime/preemption.py): bound at fit start
+        # when RLA_TPU_PREEMPT_GRACE_S is configured (None otherwise —
+        # zero per-step overhead); the step loop polls it and drains into
+        # an emergency checkpoint + typed Preempted
+        self._preempt_notice = None
+        # (saved_dp, current_dp) when the last restore crossed world
+        # sizes (elastic scale-down/up); None for same-world restores
+        self._resumed_world_resize: Optional[tuple] = None
         self.module: Optional[TpuModule] = None
         self._state: Optional[TrainState] = None
         self._mesh = None
@@ -226,9 +234,12 @@ class Trainer:
 
     def __getstate__(self):
         """The fan-out ships this trainer to workers; the live world
-        (processes, sockets, threads) stays driver-side."""
+        (processes, sockets, threads) stays driver-side.  The preemption
+        notice holds thread primitives and is per-process by design —
+        workers re-bind their own at fit start."""
         state = dict(self.__dict__)
         state["_world"] = None
+        state["_preempt_notice"] = None
         return state
 
     # ------------------------------------------------------------------ #
@@ -250,10 +261,17 @@ class Trainer:
         # the stored epoch counts COMPLETED epochs (maintained by the fit
         # loop; a max_steps-truncated epoch does not count), so a resumed run
         # neither repeats the epoch that produced the save nor skips ahead
+        # world record: lets a resume at a DIFFERENT device count detect
+        # the resize and reconcile world-shaped state (ZeRO-1 shards
+        # redistribute via global shapes; per-replica residuals reset)
+        world = {"dp": (mesh_lib.data_parallel_size(self._mesh)
+                        if self._mesh is not None else None),
+                 "processes": jax.process_count()}
         payload = ckpt_lib.build_checkpoint(
             self._state if include_state else None,
             self.epochs_completed, self.global_step,
-            hparams=getattr(self.module, "hparams", {}), callbacks=cb_states)
+            hparams=getattr(self.module, "hparams", {}), callbacks=cb_states,
+            extra={"world": world})
         if self.module is not None:
             self.module.on_save_checkpoint(payload)
         for c in self.callbacks:
@@ -271,34 +289,224 @@ class Trainer:
         elif jax.process_index() == 0:
             ckpt_lib.atomic_save(self.dump_checkpoint(), filepath)
 
+    # ------------------------------------------------------------------ #
+    # Preemption drain                                                   #
+    # ------------------------------------------------------------------ #
+    def _bind_preemption(self) -> None:
+        """Activate the preemption drain for this fit when a grace budget
+        is configured (``RLA_TPU_PREEMPT_GRACE_S``): install/attach the
+        process notice with the run dir as the cross-rank flag dir, so
+        one rank's SIGTERM drains every rank at the same step boundary.
+        Unconfigured runs keep ``_preempt_notice`` None — the step loop
+        pays nothing."""
+        from ..runtime import preemption as preempt_lib
+        notice = preempt_lib.get_notice()
+        if preempt_lib.grace_from_env() is None and not notice.enabled():
+            self._preempt_notice = None
+            return
+        notice.install(flag_dir=self.default_root_dir)
+        # a flag file left by the PREVIOUS drain must not preempt this
+        # (resumed) fit at its first step boundary
+        notice.clear_stale_flag()
+        # multi-process: the drain decision is a cross-host collective
+        # (all ranks must stop at the same boundary), so it runs on a
+        # deterministic every-N-steps schedule instead of per step --
+        # a per-step allgather would serialize the async dispatch
+        # pipeline for the run's whole lifetime.  Single process pays
+        # nothing and checks every step.
+        raw = os.environ.get(preempt_lib.PREEMPT_CONSENSUS_EVERY_ENV, "")
+        try:
+            self._preempt_check_every = max(1, int(raw)) if raw else 8
+        except ValueError:
+            log.warning("bad %s=%r; using 8",
+                        preempt_lib.PREEMPT_CONSENSUS_EVERY_ENV, raw)
+            self._preempt_check_every = 8
+        self._preempt_notice = notice
+
+    def _maybe_drain_preemption(self, every_step: bool = False) -> None:
+        """Step-boundary poll: on a (cross-rank-agreed) notice, force an
+        emergency checkpoint inside the grace budget and raise the typed
+        ``Preempted`` — ``ElasticRunner`` resumes it without charging the
+        failure budget and ``fit(ckpt_path='last')`` lands on the exact
+        saved step.  ``every_step=True`` bypasses the multi-process
+        consensus schedule — used at call sites that are already rare
+        AND SPMD-consistent (epoch boundaries on the scan path, whose
+        steps would otherwise alias the modulo and defer the drain past
+        the grace budget)."""
+        notice = self._preempt_notice
+        if notice is None:
+            return
+        from ..runtime import preemption as preempt_lib
+        if not every_step and jax.process_count() > 1 \
+                and self.global_step % self._preempt_check_every != 0:
+            # off the consensus schedule: every rank skips the same
+            # boundaries (global_step is SPMD-consistent), so the
+            # collective below always has full participation
+            return
+        if not preempt_lib.consensus_requested(notice.requested()):
+            return
+        log.warning(
+            "preemption notice (%s): draining at step %d (grace %.1fs, "
+            "%.1fs remaining)", notice.source, self.global_step,
+            notice.grace_s(), notice.remaining_s() or 0.0)
+        path = self._emergency_checkpoint()
+        self.fitting = False
+        raise preempt_lib.Preempted.at_step(
+            self.global_step, path, source=notice.source or "notice")
+
+    def _emergency_checkpoint(self) -> Optional[str]:
+        """Synchronous save for the drain path: fence any in-flight async
+        commit first (it must not straggle past the grace window), then
+        write ``preempt-step{N}.ckpt`` under the checkpoint dir.  Always
+        sync even under ``sharded-async`` — the process is about to
+        exit, and an async commit racing interpreter teardown is exactly
+        the torn checkpoint this PR exists to survive."""
+        if self._state is None or not self.enable_checkpointing:
+            return None
+        cb = self.checkpoint_callback
+        dirpath = (cb.dirpath if cb is not None and cb.dirpath
+                   else os.path.join(self.default_root_dir, "checkpoints"))
+        path = os.path.join(dirpath,
+                            f"preempt-step{self.global_step}.ckpt")
+        if self.checkpoint_format != "pickle":
+            from ..utils import sharded_checkpoint as sharded_lib
+            sharded_lib.wait_until_finished()
+            meta = self.dump_checkpoint(include_state=False)
+            sharded_lib.save_sharded(path, self._state, meta,
+                                     async_save=False)
+        elif jax.process_index() == 0:
+            ckpt_lib.atomic_save(self.dump_checkpoint(), path)
+        if jax.process_count() > 1:
+            # no rank may raise Preempted before process 0's meta.json /
+            # pickle rename is durable: the driver fails fast on the
+            # FIRST resolved future and kills the world, and a SIGKILL
+            # mid-meta-write would leave the emergency checkpoint torn
+            # (invisible to latest_checkpoint) — losing the exact-step
+            # resume this drain exists to guarantee
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("rla_emergency_ckpt")
+        log.warning("emergency checkpoint written: %s", path)
+        return path
+
+    def _detect_resize(self, payload: Dict[str, Any]) -> Optional[tuple]:
+        """(saved_dp, current_dp) when the checkpoint was written at a
+        different data-parallel world size than this run's mesh (elastic
+        scale-down after a lost host, or scale-up), else None.  Global
+        array shapes are world-independent — only per-replica state
+        (error-feedback residuals, local-grad accumulators) and the
+        shard LAYOUT change, and the layout re-resolves from the current
+        mesh in ``_compile``."""
+        saved_dp = (payload.get("world") or {}).get("dp")
+        cur_dp = mesh_lib.data_parallel_size(self._mesh)
+        if saved_dp is None or saved_dp == cur_dp:
+            return None
+        log.warning(
+            "resuming a checkpoint saved at data-parallel world size %d "
+            "onto %d: ZeRO-1/optimizer shards redistribute via their "
+            "global shapes; per-replica error-feedback residuals and "
+            "gradient accumulators reset to zero (replica-local "
+            "semantics cannot cross world sizes)", saved_dp, cur_dp)
+        return (saved_dp, cur_dp)
+
+    def _restore_sharded_state(self, ckpt_path: str, state: TrainState,
+                               resized: Optional[tuple]) -> TrainState:
+        """Orbax restore with template reconciliation.  Candidate
+        templates, in order: the run's own state (skipped on a world
+        resize — its per-replica buffers have the wrong leading dim);
+        stripped of residual/grad_accum (checkpoint predates them, or
+        carries none); carrying SAVED-world-shaped buffers (compression
+        checkpoint restored onto a different world — restored buffers
+        are then discarded for this run's fresh zeros)."""
+        from ..parallel import collectives as collectives_lib
+        from ..utils import sharded_checkpoint as sharded_lib
+
+        carries = (state.residual is not None
+                   or state.grad_accum is not None)
+        candidates = []
+        if not (resized and carries):
+            candidates.append(("full", state))
+        if carries:
+            candidates.append(
+                ("stripped",
+                 state.replace(residual=None, grad_accum=None)))
+            if resized:
+                saved_dp = resized[0]
+                res = (None if state.residual is None else
+                       collectives_lib.residual_zeros(
+                           state.params, saved_dp, self._exchange_cfg))
+                acc = (None if state.grad_accum is None else
+                       collectives_lib.accum_zeros(state.params, saved_dp))
+                candidates.append(
+                    ("saved-world",
+                     state.replace(residual=res, grad_accum=acc)))
+        last_exc = None
+        for name, template in candidates:
+            shardings = None
+            if resized:
+                # restore straight into THIS mesh's layout: abstract
+                # arrays carry the re-resolved (ZeRO-1-aware) shardings,
+                # so each process reads only the bytes its devices need
+                # and the saved shards redistribute onto the new world —
+                # never materializing through the SAVED mesh, whose
+                # devices may no longer exist
+                shardings = self._resolve_state_shardings(self.module,
+                                                          template)
+                if template.residual is not None \
+                        or template.grad_accum is not None:
+                    # saved-world-shaped buffers are discarded right
+                    # after the restore; replicate them instead of
+                    # assuming the old leading dim divides the new mesh
+                    repl = jax.sharding.NamedSharding(
+                        self._mesh, jax.sharding.PartitionSpec())
+                    shardings = shardings.replace(
+                        residual=jax.tree.map(lambda _: repl,
+                                              template.residual),
+                        grad_accum=jax.tree.map(lambda _: repl,
+                                                template.grad_accum))
+            try:
+                restored = sharded_lib.restore_sharded(ckpt_path,
+                                                       template=template,
+                                                       shardings=shardings)
+            except Exception as e:
+                last_exc = e
+                log.warning(
+                    "sharded restore with the %s template failed "
+                    "(%s: %s)%s", name, type(e).__name__, e,
+                    "; retrying with the next reconciliation"
+                    if template is not candidates[-1][1] else "")
+                continue
+            if name == "full":
+                return restored
+            # non-full template: this run keeps its own fresh (zero)
+            # residual/accumulator buffers -- error feedback loses at
+            # most one step of history
+            log.warning(
+                "error-feedback residuals/gradient accumulators reset "
+                "to zero (restored via the %s template)", name)
+            return restored.replace(residual=state.residual,
+                                    grad_accum=state.grad_accum)
+        raise last_exc
+
     def _restore(self, ckpt_path: str, state: TrainState) -> TrainState:
         from ..utils import sharded_checkpoint as sharded_lib
+        self._resumed_world_resize = None
         if sharded_lib.is_sharded_checkpoint(ckpt_path):
             payload = sharded_lib.read_metadata(ckpt_path)
-            try:
-                state = sharded_lib.restore_sharded(ckpt_path,
-                                                    template=state)
-            except Exception as e:
-                if state.residual is None and state.grad_accum is None:
-                    raise
-                # field-set drift: the checkpoint predates
-                # residual/grad_accum (or was saved without compression)
-                # while this run carries them -- orbax restore is
-                # structure-checked, so retry against a stripped
-                # template and keep this run's fresh (zero) buffers;
-                # error feedback only loses one step of history
-                log.warning(
-                    "sharded restore with residual/grad_accum in the "
-                    "template failed (%s: %s); retrying without them -- "
-                    "error-feedback state resets to zero",
-                    type(e).__name__, e)
-                restored = sharded_lib.restore_sharded(
-                    ckpt_path,
-                    template=state.replace(residual=None, grad_accum=None))
-                state = restored.replace(residual=state.residual,
-                                         grad_accum=state.grad_accum)
+            resized = self._detect_resize(payload)
+            self._resumed_world_resize = resized
+            state = self._restore_sharded_state(ckpt_path, state, resized)
         else:
             payload = ckpt_lib.read_checkpoint(ckpt_path)
+            resized = self._detect_resize(payload)
+            self._resumed_world_resize = resized
+            if resized and isinstance(payload.get("state"), dict):
+                # per-replica buffers are [saved_dp, ...]-shaped;
+                # flax.from_state_dict does not shape-check, so a silent
+                # wrong-world restore must be cut off here -- dropping
+                # them keeps the template's fresh zeros
+                for k in ("residual", "grad_accum"):
+                    if payload["state"].get(k) is not None:
+                        payload["state"][k] = None
             state = ckpt_lib.restore_state(payload, state)
         self.current_epoch = payload["epoch"]
         self.epochs_completed = payload["epoch"]
@@ -334,18 +542,19 @@ class Trainer:
             tx = optax.MultiSteps(tx, self.accumulate_grad_batches)
         return tx
 
-    def _compile(self, module: TpuModule, state: TrainState, example_batch):
+    def _resolve_state_shardings(self, module: TpuModule,
+                                 state: TrainState):
+        """State shardings for THIS run's mesh (accelerator layout +
+        ZeRO-1 re-sharding when enabled); sets ``_zero1_update_sh`` as a
+        side effect.  Shared by ``_compile`` and the sharded restore
+        path — an elastic resume re-resolves the layout against the NEW
+        (possibly smaller) mesh and restores straight into it."""
         from ..parallel import collectives as collectives_lib
 
         mesh = self._mesh
-        module.mesh = mesh  # models use this for sharding constraints
-        batch_sh = self.accelerator.batch_sharding(mesh)
         state_sh = self.accelerator.state_shardings(mesh, state,
-                                                    module=module, tx=self._tx)
-        from ..parallel.sharding import validate_shardings
-        validate_shardings(state.params, state_sh.params, mesh)
-        tx = self._tx
-
+                                                    module=module,
+                                                    tx=self._tx)
         params_replicated = all(
             s.is_fully_replicated for s in jax.tree.leaves(state_sh.params))
         if self.grad_compression is not None and not params_replicated:
@@ -367,12 +576,24 @@ class Trainer:
                     "ZeRO-1 re-sharding is skipped")
             else:
                 opt_sh = collectives_lib.zero1_opt_shardings(
-                    mesh, tx, state.opt_state, state.params)
+                    mesh, self._tx, state.opt_state, state.params)
                 if opt_sh is not None:
                     state_sh = state_sh.replace(opt_state=opt_sh)
                     self._zero1_update_sh = \
                         collectives_lib.zero1_update_shardings(
                             mesh, state.params)
+        return state_sh
+
+    def _compile(self, module: TpuModule, state: TrainState, example_batch):
+        from ..parallel import collectives as collectives_lib
+
+        mesh = self._mesh
+        module.mesh = mesh  # models use this for sharding constraints
+        batch_sh = self.accelerator.batch_sharding(mesh)
+        state_sh = self._resolve_state_shardings(module, state)
+        from ..parallel.sharding import validate_shardings
+        validate_shardings(state.params, state_sh.params, mesh)
+        tx = self._tx
 
         # batch_sh / repl act as pytree *prefixes*: one sharding covers
         # every leaf of the (arbitrary) batch / metrics subtree.
@@ -1046,6 +1267,7 @@ class Trainer:
 
         self.accelerator.setup_environment()
         self._mesh = self.accelerator.build_mesh()
+        self._bind_preemption()
 
         # sampler auto-injection (reference: ray_ddp.py:280-295)
         if self.accelerator.require_distributed_sampler:
@@ -1122,6 +1344,12 @@ class Trainer:
                 if complete:
                     self.epochs_completed = self.current_epoch + 1
                 self._after_train_epoch(module, train_metrics)
+                # the scanned epoch is ONE dispatch -- un-interruptible
+                # mid-flight by design, so the drain granularity here is
+                # the epoch boundary (checked unconditionally: epoch ends
+                # are rare and SPMD-consistent, and gating them on the
+                # per-step modulo could defer the drain past the grace)
+                self._maybe_drain_preemption(every_step=True)
                 continue
 
             if self._device_cache is not None:
@@ -1187,6 +1415,10 @@ class Trainer:
                             == 0):
                         self._mid_epoch_validation(module)
                         self._last_val_step = self.global_step
+                    # step-boundary preemption poll: drains into an
+                    # emergency checkpoint + typed Preempted (no-op when
+                    # no grace budget is configured)
+                    self._maybe_drain_preemption()
                     if self.max_steps and self.global_step >= self.max_steps:
                         self.should_stop = True
                         break
@@ -1327,6 +1559,20 @@ class Trainer:
         for leaf in jax.tree.leaves(batch):
             n = np.shape(leaf)[0]
             if n % dp_local != 0:
+                if self._resumed_world_resize is not None:
+                    # the ONE thing an elastic resize genuinely cannot
+                    # re-shard: the batch no longer divides the new
+                    # data-parallel world -- typed, so orchestration can
+                    # tell "pick a compatible size" from a plain config
+                    # error
+                    from ..runtime.elastic import ElasticResizeError
+                    saved_dp, cur_dp = self._resumed_world_resize
+                    raise ElasticResizeError(
+                        f"cannot resume at the new world size: batch dim "
+                        f"{n} is not divisible by the data-parallel size "
+                        f"{dp_local} of the shrunk mesh (checkpoint saved "
+                        f"at dp={saved_dp}, resuming at dp={cur_dp}); "
+                        f"adjust batch_size or the worker count")
                 raise ValueError(
                     f"global batch dim {n} not divisible by data-parallel "
                     f"size {dp_local}; adjust batch_size or drop_last")

@@ -67,6 +67,16 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
     if heartbeat is not None and heartbeat_s > 0:
         beat = WorkerBeat(heartbeat, heartbeat_s)
         beat.start()
+    # preemption notice handler (runtime/preemption.py), installed only
+    # when a grace budget is configured: SIGTERM then flips a drain flag
+    # the dispatched body polls (busy) or exits immediately (idle), so
+    # spot notices drain gracefully while pool teardown stays fast
+    notice = None
+    try:
+        from .preemption import install_from_env
+        notice = install_from_env(worker_mode=True)
+    except Exception:
+        pass
     # deterministic fault injection (testing/chaos.py), imported ONLY when
     # requested -- the test harness must not be a production dependency.
     # A broken spec surfaces on the first dispatch's future, not by
@@ -106,6 +116,10 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
             # backstops (queue.process_results / world.run).
             if beat is not None:
                 beat.begin_dispatch()
+            if notice is not None:
+                # busy bracket: a SIGTERM landing mid-dispatch drains at
+                # the body's next boundary instead of killing the process
+                notice.busy = True
             if chaos is not None:
                 chaos.on_dispatch()
             result = fn(*args, **kwargs)
@@ -113,6 +127,8 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
         except BaseException as e:  # ship the traceback home
             payload = ("err", cloudpickle.dumps(
                 (type(e).__name__, str(e), traceback.format_exc())))
+        if notice is not None:
+            notice.busy = False
         if beat is not None:
             beat.end_dispatch()
         conn.send_bytes(cloudpickle.dumps(payload))
@@ -136,6 +152,16 @@ class Worker:
         self.rank = rank
         self._env = dict(env or {})  # kept for restart()
         self._ctx = ctx or mp.get_context("spawn")
+        # with a preemption grace budget configured the worker installs a
+        # SIGTERM *notice* handler (runtime/preemption.py) -- SIGTERM no
+        # longer means "die", it means "drain".  Driver-initiated
+        # kill/restart must therefore go straight to SIGKILL: a swallowed
+        # terminate() would cost the full join timeout per worker AND
+        # write a bogus preemption flag into the shared run dir
+        from .preemption import PREEMPT_GRACE_ENV
+        self._sigterm_is_notice = bool(
+            self._env.get(PREEMPT_GRACE_ENV)
+            or os.environ.get(PREEMPT_GRACE_ENV))
         # liveness channel interval: explicit arg > per-worker env >
         # process env > default; <= 0 disables the channel entirely
         self._heartbeat_s = (heartbeat_s if heartbeat_s is not None
@@ -196,7 +222,13 @@ class Worker:
         runtime/elastic.py)."""
         with self._send_lock:
             if self._proc.is_alive():
-                self._proc.terminate()
+                if self._sigterm_is_notice:
+                    # SIGTERM is a drain request in this worker, not a
+                    # kill -- a busy rank would swallow it, cost the full
+                    # join timeout, and stamp a bogus preemption flag
+                    self._proc.kill()
+                else:
+                    self._proc.terminate()
             self._proc.join(timeout=10)
             if self._proc.is_alive():
                 # SIGTERM blocked/ignored (wedged in uninterruptible work):
@@ -299,7 +331,12 @@ class Worker:
 
     def kill(self) -> None:
         if self._proc.is_alive():
-            self._proc.terminate()
+            if self._sigterm_is_notice:
+                # SIGTERM means "drain" in this worker (see __init__);
+                # a deliberate kill goes straight to SIGKILL
+                self._proc.kill()
+            else:
+                self._proc.terminate()
             self._proc.join(timeout=5)
         if self._proc.is_alive():
             # SIGTERM isn't fatal to every worker: jax.distributed installs
@@ -322,6 +359,10 @@ class Worker:
 
 def _set_env(key: str, value: str) -> None:
     os.environ[key] = value
+
+
+def _probe_ok() -> bool:
+    return True
 
 
 def _node_ip() -> str:
@@ -427,6 +468,50 @@ class ActorPool:
         if restarted:
             log.warning("restarted dead workers: %s", restarted)
         return restarted
+
+    def find_lost(self, timeout_s: float = 120.0) -> List[int]:
+        """Ranks that fail a trivial round-trip dispatch within
+        ``timeout_s`` — the "is this host actually back?" probe run after
+        a restart.  A permanently lost rank (host gone; chaos
+        ``lost@rankN``) respawns and immediately dies, failing its probe
+        future fast via the collector's EOF path; healthy ranks answer as
+        soon as their interpreter finishes booting.  The timeout is
+        shared across the whole probe sweep (the dispatches run in
+        parallel)."""
+        import time as _time
+        futs = [(w.rank, w.execute(_probe_ok)) for w in self.workers]
+        deadline = _time.monotonic() + timeout_s
+        lost = []
+        for rank, f in futs:
+            try:
+                f.result(timeout=max(0.1, deadline - _time.monotonic()))
+            except BaseException as e:
+                log.warning("probe of worker %d failed: %s", rank, e)
+                lost.append(rank)
+        return lost
+
+    def drop(self, ranks: Sequence[int]) -> List[int]:
+        """Remove ``ranks`` from the pool (the elastic scale-down
+        primitive): the named workers are killed and forgotten; survivors
+        KEEP their original rank identity — rank is placement (which
+        host/slot a worker is), not position, so a surviving rank 2 stays
+        rank 2 while callers dispatch with logical ranks derived from
+        list position (``ElasticRunner`` passes the new world size to
+        ``args_per_worker``)."""
+        gone = set(ranks)
+        dropping = [w for w in self.workers if w.rank in gone]
+        for w in dropping:
+            try:
+                w.kill()
+            except BaseException:
+                pass
+        self.workers = [w for w in self.workers if w.rank not in gone]
+        dropped = [w.rank for w in dropping]
+        if dropped:
+            log.warning("dropped lost workers %s; pool now %d rank(s) %s",
+                        dropped, len(self.workers),
+                        [w.rank for w in self.workers])
+        return dropped
 
     def restart_all(self, init_hook: Optional[Callable[[], None]] = None) \
             -> List[int]:

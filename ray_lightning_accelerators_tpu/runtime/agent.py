@@ -397,6 +397,13 @@ class AgentConnection:
                         # its embedded diagnosis) so driver-side retry
                         # layers classify it correctly
                         fut.set_exception(WorkerWedged.from_message(msg))
+                    elif name == "Preempted":
+                        # same treatment for a graceful preemption drain:
+                        # the embedded step/checkpoint info must survive
+                        # the relay so the driver resumes instead of
+                        # charging a failure (runtime/preemption.py)
+                        from .preemption import Preempted
+                        fut.set_exception(Preempted.from_message(msg))
                     else:
                         fut.set_exception(RemoteError(name, msg, tb))
             except BaseException as e:

@@ -386,11 +386,18 @@ class DistributedWorld:
                              if deadline_s is not None else None)
             return process_results(futures, queue,
                                    deadline_s=hard_deadline)
-        except BaseException:
+        except BaseException as e:
             # a crashed rank leaves its peers blocked in the distributed
             # barrier; they will never drain a shutdown sentinel -- kill
             # the whole world (callers respawn a fresh one)
             self.kill()
+            from .preemption import as_preempted, is_preemption
+            if is_preemption(e):
+                # a graceful drain crossed the worker pipe as a generic
+                # RemoteError; hand the caller the TYPED outcome (step +
+                # emergency checkpoint path) so fit(ckpt_path="last")
+                # resumes instead of counting a failure
+                raise as_preempted(e) from e
             raise
         finally:
             if watchdog is not None:

@@ -4,7 +4,7 @@ The reference explicitly punts on fault tolerance — actors are created with
 no restart policy, a crash surfaces as a raised exception from the driver
 poll loop, and the README defers elasticity to RaySGD (SURVEY.md §5.3;
 reference: ray_lightning/ray_ddp.py:119, util.py:103, README.md:111).
-This module is the recovery layer that design left out, built on the two
+This module is the recovery layer that design left out, built on the
 primitives the runtime provides:
 
 - failure *detection*: a dead worker fails its futures with 'worker died'
@@ -16,25 +16,74 @@ primitives the runtime provides:
 - worker *restart*: ``pool.restart_dead()`` respawns crashed ranks with
   their rank/env intact; retries use ``pool.restart_all()`` because the
   wedge/crash survivors of a broken collective are alive-but-stuck and
-  must be cleared deliberately, not left to hang the re-dispatch.
+  must be cleared deliberately, not left to hang the re-dispatch;
+- graceful *preemption* (`runtime.preemption`): a spot/termination notice
+  drains into an emergency checkpoint and a typed ``Preempted`` — the
+  runner resumes it WITHOUT charging the failure budget (a clean drain is
+  not a failure), bounded separately by ``max_preemptions``;
+- elastic *scale-down*: when a restarted rank never comes back (host
+  gone; ``pool.find_lost`` probe fails — chaos kind ``lost@rankN``), a
+  runner with ``allow_shrink=True`` drops the rank and re-dispatches at
+  the surviving world size (``args_per_worker`` receives it), the
+  veScale-style alternative to burning every retry on an unrecoverable
+  host.
 
 Recovery is checkpoint-based, matching the framework's training semantics:
 a collective (SPMD) step cannot survive losing a participant mid-step, so
 on failure the runner restarts dead ranks and re-dispatches the whole
 attempt; the dispatched function is expected to resume from the latest
-checkpoint (see utils/checkpoint.latest_checkpoint and
+*verified* checkpoint (see utils/checkpoint.latest_checkpoint and
 Trainer.fit(ckpt_path="last")).
 """
 
 from __future__ import annotations
 
+import inspect
+import os
+import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..utils.logging import log
+from . import preemption as preempt_lib
 from .actors import ActorPool
 from .queue import TrampolineQueue, process_results
 from .watchdog import Watchdog, wedge_timeout_from_env
+
+BACKOFF_BASE_ENV = "RLA_TPU_ELASTIC_BACKOFF_S"
+BACKOFF_CAP_ENV = "RLA_TPU_ELASTIC_BACKOFF_CAP_S"
+DEFAULT_BACKOFF_CAP_S = 60.0
+
+
+class ElasticResizeError(ValueError):
+    """Resuming at a different world size is genuinely impossible: some
+    divisibility contract (per-process batch over the new data-parallel
+    size) breaks.  Typed so orchestration can tell "re-shard and go" from
+    "this run cannot continue at this size"."""
+
+
+def backoff_delay_s(attempt: int, base_s: float,
+                    cap_s: float = DEFAULT_BACKOFF_CAP_S,
+                    rng: Callable[[], float] = random.random) -> float:
+    """Exponential backoff with half-jitter: ``min(cap, base * 2**(a-1))``
+    scaled by a uniform factor in [0.5, 1.0).  ``attempt`` is 1-based (the
+    first RETRY).  Jitter keeps a fleet of runners restarting off a sick
+    shared host from hot-looping it in lockstep."""
+    if base_s <= 0 or attempt < 1:
+        return 0.0
+    d = min(cap_s, base_s * (2.0 ** (attempt - 1)))
+    return d * (0.5 + 0.5 * rng())
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("bad %s=%r; using %s", name, raw, default)
+        return default
 
 
 class ElasticRunner:
@@ -47,12 +96,30 @@ class ElasticRunner:
                  init_hook: Optional[Callable[[], None]] = None,
                  wedge_timeout_s: Optional[float] = None,
                  dispatch_deadline_s: Optional[float] = None,
-                 watchdog_poll_s: Optional[float] = None):
+                 watchdog_poll_s: Optional[float] = None,
+                 allow_shrink: bool = False,
+                 min_workers: int = 1,
+                 probe_timeout_s: float = 120.0,
+                 max_preemptions: int = 3,
+                 backoff_cap_s: float = DEFAULT_BACKOFF_CAP_S):
         """``max_failures``: attempts beyond the first before giving up.
         ``on_failure(attempt, exc)``: observer hook per failed attempt.
         ``init_hook``: re-run on restarted workers before re-dispatch
         (parity with the accelerator's per-worker init_hook,
         reference: ray_lightning/ray_ddp.py:106-107).
+
+        ``backoff_s`` is the BASE of an exponential schedule with
+        half-jitter, capped at ``backoff_cap_s`` (envs
+        ``RLA_TPU_ELASTIC_BACKOFF_S`` / ``RLA_TPU_ELASTIC_BACKOFF_CAP_S``
+        override both); 0 disables sleeping between retries.
+
+        ``allow_shrink``: when a restarted rank fails its liveness probe
+        (host permanently gone), drop it and continue at the surviving
+        world size instead of failing every retry — requires
+        ``args_per_worker`` to accept ``(attempt, world_size)`` so the
+        dispatched work re-partitions.  ``min_workers`` floors the
+        shrink.  ``max_preemptions`` bounds graceful-preemption resumes
+        (which do NOT consume the failure budget).
 
         Hang-aware supervision runs when any of ``wedge_timeout_s``
         (stale-heartbeat threshold), ``dispatch_deadline_s`` (per-attempt
@@ -62,51 +129,132 @@ class ElasticRunner:
         retryably with ``WorkerWedged`` instead of hanging forever."""
         self.pool = pool
         self.max_failures = max_failures
-        self.backoff_s = backoff_s
+        self.backoff_s = _env_float(BACKOFF_BASE_ENV, backoff_s)
+        self.backoff_cap_s = _env_float(BACKOFF_CAP_ENV, backoff_cap_s)
         self.on_failure = on_failure
         self.init_hook = init_hook
         self.wedge_timeout_s = wedge_timeout_s
         self.dispatch_deadline_s = dispatch_deadline_s
         self.watchdog_poll_s = watchdog_poll_s
+        self.allow_shrink = allow_shrink
+        self.min_workers = max(1, min_workers)
+        self.probe_timeout_s = probe_timeout_s
+        self.max_preemptions = max_preemptions
         self.attempts_used = 0
         # wedge diagnosis records accumulated across attempts (one dict
         # per reaped rank, runtime/watchdog.py death-record shape)
         self.wedge_events: List[Dict[str, Any]] = []
+        # graceful preemption drains resumed (typed Preempted, one per
+        # resumed attempt) and scale-down records ({"dropped": ranks,
+        # "world_size": new size})
+        self.preempt_events: List[preempt_lib.Preempted] = []
+        self.shrink_events: List[Dict[str, Any]] = []
+        # driver-side notice: installed when RLA_TPU_PREEMPT_GRACE_S is
+        # configured, so a driver SIGTERM ends the retry loop instead of
+        # respawning workers on a host that is going away
+        self._notice = preempt_lib.install_from_env()
 
     def _supervised(self) -> bool:
         return (self.wedge_timeout_s is not None
                 or self.dispatch_deadline_s is not None
                 or wedge_timeout_from_env() is not None)
 
+    def _build_args(self, args_per_worker, attempt: int) -> Sequence[tuple]:
+        """Per-rank argument tuples; callables accepting a second
+        parameter receive the CURRENT world size (required under
+        ``allow_shrink`` — re-dispatch after a scale-down must
+        re-partition the work)."""
+        try:
+            params = [
+                p for p in
+                inspect.signature(args_per_worker).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            # world-size-aware means an explicit, REQUIRED second
+            # positional slot: a defaulted second param (attempt,
+            # ckpt_dir=...), **opts, *args, or keyword-only extras keep
+            # the legacy 1-arg call — silently overwriting a default
+            # with the pool size would corrupt existing builders
+            takes_world = (len(params) >= 2
+                           and params[1].default is inspect.Parameter.empty)
+        except (TypeError, ValueError):
+            takes_world = False
+        if takes_world:
+            args = args_per_worker(attempt, len(self.pool))
+        else:
+            args = args_per_worker(attempt)
+        if len(args) != len(self.pool):
+            raise ValueError(
+                f"args_per_worker built {len(args)} argument tuples for a "
+                f"pool of {len(self.pool)} workers; under allow_shrink it "
+                "must accept (attempt, world_size) and size its output to "
+                "the current world")
+        return args
+
+    def _prepare_retry(self, attempt: int, failures: int) -> None:
+        """Between-attempt recovery: backoff, restart every rank (clearing
+        alive-but-stuck survivors of the broken collective), drop ranks
+        whose host never came back (scale-down), re-run the init hook."""
+        delay = backoff_delay_s(failures, self.backoff_s,
+                                self.backoff_cap_s)
+        if delay > 0:
+            log.warning("elastic backoff %.2fs before attempt %d",
+                        delay, attempt + 1)
+            time.sleep(delay)
+        restarted = self.pool.restart_all(
+            init_hook=None if self.allow_shrink else self.init_hook)
+        log.warning("elastic attempt %d (restarted ranks %s)",
+                    attempt + 1, restarted)
+        if not self.allow_shrink:
+            return
+        lost = self.pool.find_lost(timeout_s=self.probe_timeout_s)
+        if lost:
+            survivors = len(self.pool) - len(lost)
+            if survivors < self.min_workers:
+                raise RuntimeError(
+                    f"elastic scale-down impossible: ranks {lost} are "
+                    f"gone, leaving {survivors} < min_workers="
+                    f"{self.min_workers}")
+            dropped = self.pool.drop(lost)
+            event = {"dropped": dropped, "world_size": len(self.pool),
+                     "attempt": attempt + 1}
+            self.shrink_events.append(event)
+            log.warning("elastic scale-down: %s", event)
+        if self.init_hook is not None:
+            for f in self.pool.execute_all(self.init_hook):
+                f.result()
+
     def run(self, fn: Callable,
-            args_per_worker: Optional[Callable[[int], Sequence[tuple]]]
+            args_per_worker: Optional[Callable[..., Sequence[tuple]]]
             = None,
             queue: Optional[TrampolineQueue] = None) -> List[Any]:
         """Dispatch ``fn`` to every worker until one attempt fully succeeds.
 
-        ``args_per_worker(attempt)`` builds the per-rank argument tuples for
-        a given attempt — resume state (e.g. the latest checkpoint path)
-        belongs there.  ``fn`` must be re-runnable: each retry re-executes
-        the whole attempt on all ranks (collective steps cannot continue
-        with a hole in the mesh)."""
+        ``args_per_worker(attempt)`` — or ``(attempt, world_size)`` when
+        the work must re-partition after a scale-down — builds the
+        per-rank argument tuples for a given attempt; resume state (e.g.
+        the latest checkpoint path) belongs there.  ``fn`` must be
+        re-runnable: each retry re-executes the whole attempt on all
+        ranks (collective steps cannot continue with a hole in the
+        mesh)."""
         last_exc: Optional[BaseException] = None
-        for attempt in range(self.max_failures + 1):
+        attempt = 0
+        failures = 0
+        preemptions = 0
+        while True:
             self.attempts_used = attempt + 1
             if attempt > 0:
-                if self.backoff_s:
-                    time.sleep(self.backoff_s * attempt)
                 # restart every rank, not just dead ones: survivors of a
                 # broken collective (and watchdog-reaped wedges' peers)
-                # are alive-but-stuck and would never dequeue the retry --
-                # clearing them is deliberate, not a side effect
-                restarted = self.pool.restart_all(init_hook=self.init_hook)
-                log.warning("elastic attempt %d/%d (restarted ranks %s)",
-                            attempt + 1, self.max_failures + 1, restarted)
+                # are alive-but-stuck and would never dequeue the retry
+                self._prepare_retry(attempt, failures)
             watchdog: Optional[Watchdog] = None
+            # built OUTSIDE the try: a mis-sized args_per_worker is a
+            # configuration error, not a retryable attempt failure
+            args = (self._build_args(args_per_worker, attempt)
+                    if args_per_worker is not None else None)
             try:
-                if args_per_worker is not None:
-                    futures = self.pool.execute_per_worker(
-                        fn, args_per_worker(attempt))
+                if args is not None:
+                    futures = self.pool.execute_per_worker(fn, args)
                 else:
                     futures = self.pool.execute_all(fn)
                 hard_deadline = None
@@ -130,14 +278,37 @@ class ElasticRunner:
                                        deadline_s=hard_deadline)
             except BaseException as e:  # noqa: BLE001 — resurfaced below
                 last_exc = e
-                if self.on_failure is not None:
-                    self.on_failure(attempt, e)
-                if attempt == self.max_failures:
-                    break
+                if preempt_lib.is_preemption(e):
+                    # a drained preemption is a RESUME, not a failure:
+                    # state is checkpointed, the budget stays intact
+                    preempted = preempt_lib.as_preempted(e)
+                    self.preempt_events.append(preempted)
+                    if (self._notice is not None
+                            and self._notice.requested()):
+                        # the DRIVER is being preempted too: hand the
+                        # typed outcome up instead of respawning workers
+                        # on a host that is going away
+                        raise preempted from e
+                    preemptions += 1
+                    if preemptions > self.max_preemptions:
+                        raise RuntimeError(
+                            f"elastic run preempted {preemptions} times "
+                            f"(max_preemptions={self.max_preemptions})"
+                        ) from e
+                    log.warning("attempt %d preempted (%s); resuming "
+                                "from emergency checkpoint",
+                                attempt + 1, preempted)
+                else:
+                    failures += 1
+                    if self.on_failure is not None:
+                        self.on_failure(attempt, e)
+                    if failures > self.max_failures:
+                        break
             finally:
                 if watchdog is not None:
                     watchdog.stop()
                     self.wedge_events.extend(watchdog.reaped)
+            attempt += 1
         raise RuntimeError(
             f"elastic run failed after {self.max_failures + 1} attempts"
         ) from last_exc

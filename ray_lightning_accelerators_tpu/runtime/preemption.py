@@ -1,0 +1,374 @@
+"""Preemption drain: turn a termination notice into a clean save-and-exit.
+
+Spot/preemptible TPU reservations end with a SIGTERM and a short grace
+window, not a crash — yet the reference (and PR 1's watchdog/elastic
+layer) only knows crashes and hangs, so a preempted host counts as a
+failure, burns a retry, and loses every step since the last periodic
+checkpoint.  veScale (PAPERS.md) treats preemption as a first-class,
+*graceful* outcome; this module is that path for this runtime:
+
+- **PreemptionNotice**: a per-process singleton flag.  ``install()``
+  hooks SIGTERM (workers install automatically when
+  ``RLA_TPU_PREEMPT_GRACE_S`` is set in their env — see
+  ``runtime/actors._worker_main``); a notice can also be raised
+  programmatically (``request_local``) or cross-rank through a flag
+  file on the shared run dir (every rank's handler writes it; every
+  rank's fit loop polls it), so one rank's SIGTERM drains the whole
+  SPMD job, not just the signaled process.
+- **Drain contract**: the training loop polls ``requested()`` at step
+  boundaries, forces an emergency checkpoint (fencing any in-flight
+  async commit inside the grace budget), and raises **Preempted** — a
+  typed outcome distinct from a crash (``RemoteError``/'worker died')
+  and a hang (``WorkerWedged``).  ``ElasticRunner`` resumes preempted
+  attempts without charging the failure budget;
+  ``Trainer.fit(ckpt_path="last")`` resumes at the exact saved step.
+- **Grace budget**: ``RLA_TPU_PREEMPT_GRACE_S`` seconds from notice to
+  forced exit.  Worker-side, a hard-exit timer enforces it (the cloud
+  yanks the host at the deadline whether or not the drain finished);
+  an idle worker exits immediately on SIGTERM (nothing to drain), so
+  pool shutdown/restart stays fast.
+
+The wire shape matches ``WorkerWedged``: a ``Preempted`` raised inside a
+worker crosses the pipe/agent relay as ``(name, message, traceback)`` and
+is rebuilt driver-side from the marker embedded in its message.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import log
+
+PREEMPT_GRACE_ENV = "RLA_TPU_PREEMPT_GRACE_S"
+# multi-process fits run the cross-host drain consensus every N steps
+# (a deterministic schedule, so the collective always has full
+# participation); single-process runs check every step for free
+PREEMPT_CONSENSUS_EVERY_ENV = "RLA_TPU_PREEMPT_CONSENSUS_EVERY"
+DEFAULT_GRACE_S = 30.0
+# exit code of a worker's hard-exit timer (grace expired mid-drain) and
+# of an idle worker exiting on SIGTERM with a notice handler installed
+PREEMPT_EXIT_CODE = 45
+FLAG_FILENAME = ".rla_preempt_notice"
+
+
+def grace_from_env() -> Optional[float]:
+    """The configured grace budget, or None when preemption handling is
+    not enabled (the handler stays uninstalled; SIGTERM keeps its default
+    kill semantics so pool teardown is never slowed down)."""
+    raw = os.environ.get(PREEMPT_GRACE_ENV, "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("bad %s=%r; using %.1fs", PREEMPT_GRACE_ENV, raw,
+                    DEFAULT_GRACE_S)
+        return DEFAULT_GRACE_S
+
+
+class Preempted(RuntimeError):
+    """The run was preempted and drained cleanly: state is checkpointed
+    and the job should be resumed (``fit(ckpt_path="last")``), not
+    retried as a failure.  Distinct from ``RemoteError`` (worker crash)
+    and ``WorkerWedged`` (hang): retry layers treat it as a
+    resume-without-penalty outcome."""
+
+    _MARKER = "| preempted="
+
+    def __init__(self, message: str, step: Optional[int] = None,
+                 ckpt_path: Optional[str] = None,
+                 info: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.step = step
+        self.ckpt_path = ckpt_path
+        self.info = dict(info or {})
+
+    @classmethod
+    def at_step(cls, step: int, ckpt_path: Optional[str] = None,
+                source: str = "notice") -> "Preempted":
+        info = {"step": int(step), "ckpt_path": ckpt_path,
+                "source": source}
+        msg = (f"preemption notice ({source}): drained at step {step}"
+               + (f", emergency checkpoint at {ckpt_path}" if ckpt_path
+                  else ", no emergency checkpoint written")
+               + f" {cls._MARKER}{json.dumps(info, sort_keys=True)}")
+        return cls(msg, step=step, ckpt_path=ckpt_path, info=info)
+
+    @classmethod
+    def from_message(cls, message: str) -> "Preempted":
+        """Rebuild from a message that crossed a wire as (name, str, tb),
+        recovering the embedded step/checkpoint info."""
+        info: Dict[str, Any] = {}
+        i = message.find(cls._MARKER)
+        if i >= 0:
+            tail = message[i + len(cls._MARKER):].splitlines()[0]
+            try:
+                info = json.loads(tail)
+            except ValueError:
+                pass
+        return cls(message, step=info.get("step"),
+                   ckpt_path=info.get("ckpt_path"), info=info)
+
+
+def is_preemption(exc: BaseException) -> bool:
+    """Typed check that survives the worker pipe / agent relay: a
+    ``Preempted`` instance, or any exception whose message carries the
+    preemption marker (``RemoteError`` wraps the original as
+    ``'Preempted: <message>'``)."""
+    if isinstance(exc, Preempted):
+        return True
+    return Preempted._MARKER in str(exc)
+
+
+def as_preempted(exc: BaseException) -> Preempted:
+    """The typed form of any preemption-classified exception."""
+    if isinstance(exc, Preempted):
+        return exc
+    return Preempted.from_message(str(exc))
+
+
+class PreemptionNotice:
+    """Per-process preemption flag + SIGTERM plumbing.
+
+    One singleton per process (``get_notice``).  ``requested()`` is true
+    once a notice arrived by signal, by ``request_local()``, or through
+    the attached flag file (cross-rank propagation over the shared run
+    dir).  The flag is sticky until ``clear()``.
+    """
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._installed = False
+        self._prev_handler = None
+        self._worker_mode = False
+        self._flag_dir: Optional[str] = None
+        self._deadline: Optional[float] = None
+        self._timer: Optional[threading.Timer] = None
+        self.source: Optional[str] = None
+        # dispatch-in-progress marker (worker side): an idle worker dies
+        # on SIGTERM like it always did; only mid-work notices drain
+        self.busy = False
+
+    # -- state ---------------------------------------------------------- #
+    def enabled(self) -> bool:
+        """Preemption handling is active: a handler is installed, a grace
+        budget is configured, or a notice was already raised."""
+        return (self._installed or grace_from_env() is not None
+                or self._event.is_set())
+
+    def requested(self) -> bool:
+        if self._event.is_set():
+            return True
+        path = self._flag_path()
+        if path is not None and os.path.exists(path):
+            # another rank's handler raised the notice on the shared dir
+            self._event.set()
+            if self.source is None:
+                self.source = "flag-file"
+            self._arm_deadline()
+            return True
+        return False
+
+    def grace_s(self) -> float:
+        g = grace_from_env()
+        return DEFAULT_GRACE_S if g is None else g
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds left in the grace budget, or None before any notice."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def _flag_path(self) -> Optional[str]:
+        if self._flag_dir is None:
+            return None
+        return os.path.join(self._flag_dir, FLAG_FILENAME)
+
+    def attach_flag_dir(self, directory: str) -> None:
+        """Propagate notices through ``directory`` (the shared run dir):
+        this process's handler writes the flag file there, and
+        ``requested()`` polls it — one rank's SIGTERM reaches every rank
+        without any collective."""
+        self._flag_dir = directory
+
+    def clear_stale_flag(self) -> None:
+        """Remove a flag file left by a PREVIOUS drain.  A notice applies
+        to the allocation that received it; resumed/fresh runs over the
+        same run dir must not re-drain off the old file (one stale flag
+        would otherwise preempt every later fit at its first step).
+        Never clears while THIS process holds a live notice.  If another
+        rank's fresh signal races this unlink, that rank still drains
+        from its sticky local event and re-propagates."""
+        if self._event.is_set():
+            return
+        path = self._flag_path()
+        if path is None:
+            return
+        try:
+            os.unlink(path)
+            log.warning("cleared stale preemption flag file %s (left by "
+                        "a previous drain)", path)
+        except OSError:
+            pass
+
+    # -- raising a notice ----------------------------------------------- #
+    def request_local(self, source: str = "manual") -> None:
+        """Raise the notice in this process only (tests, schedulers that
+        know the reservation is ending)."""
+        first = not self._event.is_set()
+        self._event.set()
+        if first:
+            self.source = source
+            self._arm_deadline()
+
+    def request(self, source: str = "manual") -> None:
+        """Raise the notice AND write the cross-rank flag file (when a
+        flag dir is attached), so every rank of the job drains."""
+        self.request_local(source)
+        path = self._flag_path()
+        if path is None:
+            return
+        try:
+            os.makedirs(self._flag_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"source": source, "pid": os.getpid(),
+                           "grace_s": self.grace_s()}, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("could not write preemption flag file %s: %s",
+                        path, e)
+
+    def _arm_deadline(self) -> None:
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self.grace_s()
+        if self._worker_mode and self._timer is None:
+            # the cloud yanks the host at the deadline whether or not the
+            # drain finished; mirroring that worker-side keeps a stuck
+            # drain from wedging the pool (daemon: dies with the process)
+            t = threading.Timer(self.grace_s(), os._exit,
+                                args=(PREEMPT_EXIT_CODE,))
+            t.daemon = True
+            t.start()
+            self._timer = t
+
+    # -- signal plumbing ------------------------------------------------- #
+    def _handle_sigterm(self, signum, frame) -> None:
+        if not self.busy:
+            # idle worker: nothing to drain — die like default SIGTERM so
+            # shutdown/restart paths stay fast.  (Driver installs with
+            # worker_mode=False and never hard-exits here.)
+            if self._worker_mode:
+                os._exit(PREEMPT_EXIT_CODE)
+        if self._event.is_set():
+            # second SIGTERM: the notice is already raised, so the sender
+            # wants termination, not another drain — restore the default
+            # disposition and terminate (the graceful-then-force
+            # convention; keeps a drained driver killable by `kill`)
+            import signal
+            try:
+                signal.signal(signal.SIGTERM,
+                              self._prev_handler or signal.SIG_DFL)
+                self._installed = False
+            except ValueError:
+                pass
+            os.kill(os.getpid(), signum)
+            return
+        self.request(source=f"signal-{signum}")
+
+    def install(self, worker_mode: bool = False,
+                flag_dir: Optional[str] = None) -> bool:
+        """Hook SIGTERM as a preemption notice.  Returns False (and stays
+        uninstalled) outside the main thread — ``request_local`` and the
+        flag file still work there."""
+        import signal
+        if flag_dir is not None:
+            self.attach_flag_dir(flag_dir)
+        if self._installed:
+            self._worker_mode = self._worker_mode or worker_mode
+            return True
+        try:
+            self._prev_handler = signal.signal(signal.SIGTERM,
+                                               self._handle_sigterm)
+        except ValueError:
+            log.warning("preemption notice handler not installed "
+                        "(not in the main thread); SIGTERM keeps default "
+                        "semantics, flag-file/manual notices still work")
+            return False
+        self._installed = True
+        self._worker_mode = worker_mode
+        return True
+
+    def uninstall(self) -> None:
+        """Restore the previous SIGTERM handler (test hygiene)."""
+        if not self._installed:
+            return
+        import signal
+        try:
+            signal.signal(signal.SIGTERM,
+                          self._prev_handler or signal.SIG_DFL)
+        except ValueError:
+            pass
+        self._installed = False
+        self._prev_handler = None
+
+    def clear(self) -> None:
+        """Drop a raised notice (test hygiene; a real drain ends the
+        process or the attempt, never reuses the notice)."""
+        self._event.clear()
+        self.source = None
+        self._deadline = None
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        path = self._flag_path()
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+_notice: Optional[PreemptionNotice] = None
+
+
+def get_notice() -> PreemptionNotice:
+    global _notice
+    if _notice is None:
+        _notice = PreemptionNotice()
+    return _notice
+
+
+def install_from_env(worker_mode: bool = False,
+                     flag_dir: Optional[str] = None
+                     ) -> Optional[PreemptionNotice]:
+    """Install the SIGTERM notice handler iff ``RLA_TPU_PREEMPT_GRACE_S``
+    is configured; returns the notice (or None when disabled).  Workers
+    call this at process start (``runtime/actors._worker_main``); the
+    driver's fit loop calls it with the run dir as ``flag_dir``."""
+    if grace_from_env() is None:
+        return None
+    notice = get_notice()
+    notice.install(worker_mode=worker_mode, flag_dir=flag_dir)
+    return notice
+
+
+def consensus_requested(local: bool) -> bool:
+    """SPMD-consistent drain decision: every process must stop at the
+    same step boundary, so in a multi-process world the local flag is
+    max-reduced across processes (a tiny scalar all-gather, paid only
+    when preemption handling is enabled).  Single process: the local
+    flag IS the decision."""
+    import jax
+    if jax.process_count() == 1:
+        return local
+    import numpy as np
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if local else 0], np.int32))
+    return bool(np.max(flags))

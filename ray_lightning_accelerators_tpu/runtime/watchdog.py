@@ -422,8 +422,14 @@ class Watchdog:
 def stall_record(exc: BaseException, stage: str) -> Dict[str, Any]:
     """A machine-readable stall diagnosis mirroring bench.py's
     death-record shape: flat JSON-able dict with ``error``/``detail``
-    plus ``stall_*`` context keys from the wedge diagnosis."""
-    if isinstance(exc, WorkerWedged):
+    plus ``stall_*`` context keys from the wedge diagnosis.  A graceful
+    preemption drain (runtime/preemption.py) is classified distinctly --
+    it is a resume point, not a stall, and dashboards keying on
+    ``error`` must not count it against reliability."""
+    from .preemption import is_preemption
+    if is_preemption(exc):
+        error = "preempted"
+    elif isinstance(exc, WorkerWedged):
         error = "worker wedged"
     elif isinstance(exc, TimeoutError):
         error = "attempt deadline exceeded"

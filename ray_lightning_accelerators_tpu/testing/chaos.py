@@ -16,14 +16,27 @@ Syntax (comma-separated faults)::
 - kind: ``crash`` (``os._exit`` with exit code 43), ``hang`` (freeze the
   heartbeat, then sleep forever -- simulates a fully frozen process, so
   the watchdog's stale-beat path fires), ``slow`` (delay the dispatch by
-  the given seconds -- a straggler that still completes);
+  the given seconds -- a straggler that still completes), ``preempt``
+  (deliver SIGTERM to the worker itself -- with a
+  ``runtime.preemption`` notice handler installed via
+  ``RLA_TPU_PREEMPT_GRACE_S`` this simulates a spot/preemption notice
+  the dispatched body drains gracefully; without one it is a plain
+  SIGTERM death), ``lost`` (``os._exit`` with exit code 44 AND a
+  persistent "host gone" marker under ``RLA_TPU_CHAOS_NS``: every
+  respawn of that rank dies at boot, so ``pool.restart_dead()`` can
+  never bring it back -- the permanently lost host that forces an
+  elastic scale-down);
 - target: ``rankN`` or ``all``;
 - qualifiers: ``stepN`` -- fire on the Nth dispatch of the worker
-  process's lifetime (1-based; crash/hang default to step 1, slow
-  defaults to every dispatch); a float -- the delay for ``slow``;
-  ``once`` -- fire at most once across process RESTARTS (claimed through
-  an atomic token file under the ``RLA_TPU_CHAOS_NS`` directory), so a
-  wedge->restart->resume loop converges deterministically.
+  process's lifetime (1-based; crash/hang/preempt/lost default to step
+  1, slow defaults to every dispatch); a float -- the delay for
+  ``slow``; ``once`` -- fire at most once across process RESTARTS
+  (claimed through an atomic token file under the ``RLA_TPU_CHAOS_NS``
+  directory), so a wedge->restart->resume loop converges
+  deterministically.  ``lost`` markers are keyed by the rank the fault
+  fired on: after an elastic scale-down drops that rank, surviving
+  ranks (which keep their original rank identity) never inherit the
+  marker.
 
 Faults fire BEFORE the dispatched fn runs, counting every dispatch
 (including runtime-internal ones such as ``initialize_worker``); tests
@@ -42,7 +55,8 @@ from typing import Callable, List, Optional
 CHAOS_ENV = "RLA_TPU_CHAOS"
 CHAOS_NS_ENV = "RLA_TPU_CHAOS_NS"
 CHAOS_EXIT_CODE = 43
-_KINDS = ("crash", "hang", "slow")
+LOST_EXIT_CODE = 44
+_KINDS = ("crash", "hang", "slow", "preempt", "lost")
 
 
 @dataclass(frozen=True)
@@ -137,10 +151,17 @@ class ChaosInjector:
         self.freeze_heartbeat = freeze_heartbeat
         self.ns_dir = ns_dir
         self._step = 0
-        if any(f.once for f in faults) and not ns_dir:
+        if any(f.once or f.kind == "lost" for f in faults) and not ns_dir:
             raise ValueError(
-                f"chaos 'once' faults need {CHAOS_NS_ENV} set to a "
-                "directory (the cross-restart claim store)")
+                f"chaos 'once' and 'lost' faults need {CHAOS_NS_ENV} set "
+                "to a directory (the cross-restart claim store)")
+        # a rank whose 'lost' fault already fired is a gone host: every
+        # respawned generation dies at boot, before serving any dispatch
+        for f in faults:
+            if (f.kind == "lost"
+                    and (f.rank is None or f.rank == rank)
+                    and os.path.exists(self._lost_marker(f))):
+                os._exit(LOST_EXIT_CODE)
 
     @classmethod
     def from_env(cls, rank: int,
@@ -151,6 +172,12 @@ class ChaosInjector:
             return None
         return cls(parse_chaos(spec), rank, freeze_heartbeat,
                    os.environ.get(CHAOS_NS_ENV) or None)
+
+    def _lost_marker(self, fault: ChaosFault) -> str:
+        """Persistent 'host gone' marker path for a lost fault on THIS
+        rank (rank-keyed: an elastic scale-down that drops the rank never
+        leaks the marker onto survivors, which keep their own ranks)."""
+        return os.path.join(self.ns_dir, fault.token(self.rank) + ".lost")
 
     def _claim_once(self, fault: ChaosFault) -> bool:
         """Atomically claim a once-fault across processes AND restarts:
@@ -176,6 +203,24 @@ class ChaosInjector:
                 time.sleep(fault.delay_s)
             elif fault.kind == "crash":
                 os._exit(CHAOS_EXIT_CODE)
+            elif fault.kind == "preempt":
+                # a spot notice IS a SIGTERM: the runtime.preemption
+                # handler (installed when RLA_TPU_PREEMPT_GRACE_S is in
+                # the worker env) flips the notice the dispatched body
+                # drains; with no handler the default disposition kills
+                # the process -- both are the real contract
+                import signal
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif fault.kind == "lost":
+                # host gone: persist the marker FIRST so every respawn
+                # dies at boot, then die
+                os.makedirs(self.ns_dir, exist_ok=True)
+                try:
+                    os.close(os.open(self._lost_marker(fault),
+                                     os.O_CREAT | os.O_WRONLY))
+                except OSError:
+                    pass
+                os._exit(LOST_EXIT_CODE)
             elif fault.kind == "hang":
                 if self.freeze_heartbeat is not None:
                     self.freeze_heartbeat()

@@ -89,12 +89,50 @@ def restore_params(payload: Dict[str, Any], template_params):
                                               payload["state"]["params"])
 
 
-def latest_checkpoint(directory: str, pattern: str = "*.ckpt") -> str | None:
-    """Newest checkpoint file under `directory` (recursive), or None.
+# memoized pickle verdicts (abspath -> (mtime, size, ok, reason)):
+# full-unpickle verification of a multi-GB file must not repeat on every
+# retention-GC pass while the file is unchanged; consulted only with
+# use_cache (restore-time checks keep the full load)
+_pickle_verify_cache: dict = {}
 
-    The resume anchor for crash recovery (Trainer.fit(ckpt_path="last"),
-    runtime/elastic.py) — capability the reference lacks (SURVEY.md §5.4:
-    'No mid-run resume of a crashed job')."""
+
+def verify_checkpoint(filepath: str,
+                      use_cache: bool = False) -> tuple[bool, str]:
+    """Integrity check over either checkpoint format: sharded dirs run
+    the digest pass (utils/sharded_checkpoint.verify_checkpoint; with
+    ``use_cache`` a save-primed verdict is accepted for unmodified
+    trees); pickle files must unpickle end-to-end (a truncated pickle
+    raises mid-load; with ``use_cache`` the verdict is memoized per
+    mtime+size).  Returns ``(ok, reason)`` — never raises."""
+    from . import sharded_checkpoint as sharded_lib
+    if os.path.isdir(filepath):
+        return sharded_lib.verify_checkpoint(filepath, use_cache=use_cache)
+    if not os.path.isfile(filepath):
+        return False, "missing"
+    key = os.path.abspath(filepath)
+    try:
+        st = os.stat(filepath)
+        stamp = (st.st_mtime, st.st_size)
+    except OSError:
+        stamp = None
+    if use_cache and stamp is not None:
+        cached = _pickle_verify_cache.get(key)
+        if cached is not None and cached[:2] == stamp:
+            return cached[2], cached[3]
+    try:
+        read_checkpoint(filepath)
+        verdict = (True, "ok")
+    except Exception as e:  # torn write, disk corruption, wrong file
+        verdict = (False, f"unreadable pickle: {type(e).__name__}: {e}")
+    if stamp is not None:
+        _pickle_verify_cache[key] = stamp + verdict
+    return verdict
+
+
+def list_checkpoints(directory: str,
+                     pattern: str = "*.ckpt") -> list[str]:
+    """Every checkpoint under ``directory`` (recursive; pickle files plus
+    sharded dirs marked complete by their meta.json), newest first."""
     import glob
 
     # escape the user directory: hyperparameter-stamped run dirs often carry
@@ -107,6 +145,73 @@ def latest_checkpoint(directory: str, pattern: str = "*.ckpt") -> str | None:
     candidates += [os.path.dirname(m) for m in glob.glob(
         os.path.join(glob.escape(directory), "**", sharded_lib.META_FILE),
         recursive=True)]
-    if not candidates:
-        return None
-    return max(candidates, key=os.path.getmtime)
+    return sorted(candidates, key=os.path.getmtime, reverse=True)
+
+
+def latest_checkpoint(directory: str, pattern: str = "*.ckpt",
+                      verify: bool = True) -> str | None:
+    """Newest VERIFIED checkpoint under `directory` (recursive), or None.
+
+    The resume anchor for crash recovery (Trainer.fit(ckpt_path="last"),
+    runtime/elastic.py) — capability the reference lacks (SURVEY.md §5.4:
+    'No mid-run resume of a crashed job').  Candidates are walked newest
+    first and each is integrity-checked (``verify_checkpoint``): a torn
+    or corrupt newest checkpoint — the one a crash/preemption most likely
+    damaged — is skipped with a warning and the resume falls back to the
+    previous verified one instead of handing the trainer garbage.
+    ``verify=False`` restores the raw newest-by-mtime pick."""
+    from .logging import log
+
+    for cand in list_checkpoints(directory, pattern):
+        if not verify:
+            return cand
+        ok, why = verify_checkpoint(cand)
+        if ok:
+            return cand
+        log.warning("skipping unverified checkpoint %s: %s", cand, why)
+    return None
+
+
+def prune_checkpoints(directory: str, keep_last_k: int,
+                      protect: tuple | list = (),
+                      pattern: str = "*.ckpt") -> list[str]:
+    """Retention GC: keep the newest ``keep_last_k`` checkpoints under
+    ``directory`` and delete the rest — EXCEPT that the newest *verified*
+    checkpoint is always kept, even when it is older than the retention
+    window (if every kept checkpoint is torn, deleting the last good one
+    would destroy the only resume anchor).  ``protect`` paths (e.g. a
+    tracked best_model_path) are never deleted.  Returns removed paths."""
+    from . import sharded_checkpoint as sharded_lib
+    from .logging import log
+
+    if keep_last_k is None or keep_last_k < 1:
+        return []
+    candidates = list_checkpoints(directory, pattern)
+    protected = {os.path.abspath(p) for p in protect if p}
+    if not [p for p in candidates[keep_last_k:]
+            if os.path.abspath(p) not in protected]:
+        # nothing would be deleted: skip the digest pass entirely (this
+        # runs every validation end -- re-hashing multi-GB checkpoints
+        # to confirm an anchor nobody is about to delete is waste)
+        return []
+    keep = set(candidates[:keep_last_k])
+    # use_cache: a checkpoint this process just saved (and digested) is
+    # accepted without a re-hash; only checkpoints of unknown provenance
+    # pay the full pass
+    if not any(verify_checkpoint(p, use_cache=True)[0] for p in keep):
+        for p in candidates[keep_last_k:]:
+            if verify_checkpoint(p, use_cache=True)[0]:
+                keep.add(p)
+                log.warning(
+                    "checkpoint retention: every checkpoint in the "
+                    "keep_last_k=%d window failed verification; keeping "
+                    "older verified %s as the resume anchor",
+                    keep_last_k, p)
+                break
+    removed = []
+    for p in candidates[keep_last_k:]:
+        if p in keep or os.path.abspath(p) in protected:
+            continue
+        sharded_lib.remove_checkpoint(p)
+        removed.append(p)
+    return removed

@@ -13,30 +13,62 @@ This module is the TPU-native path:
   tensorstore OCDBT); no cross-host gather, IO bandwidth scales with hosts.
 - **restore**: pass abstract arrays carrying target shardings and each
   process reads only the bytes its devices need — a pod restores a
-  checkpoint without any host ever holding the full state.
+  checkpoint without any host ever holding the full state.  Because the
+  abstract arrays carry GLOBAL shapes, the same checkpoint restores onto a
+  *different* device count: the target shardings redistribute the saved
+  shards (the portable-redistribution primitive elastic resume needs).
 - **async**: ``sharded-async`` hands the device arrays to a background
   committer so training continues while bytes hit disk
-  (``wait_until_finished`` fences).
+  (``wait_until_finished`` fences; an atexit hook fences at interpreter
+  exit so the last save's completion marker is never lost).
+- **integrity**: ``meta.json`` embeds per-file SHA-256 digests of the
+  committed state tree, written AFTER the array commit —
+  ``verify_checkpoint`` recomputes them, so a torn or bit-rotted
+  checkpoint is detected before a restore walks into it.
 
 Layout: ``<path>/state/`` (orbax tree) + ``<path>/meta.json`` (epoch, step,
-hparams, callback states — the non-array half of the payload).
+hparams, callback states, integrity record — the non-array half of the
+payload).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 
 STATE_DIR = "state"
 META_FILE = "meta.json"
+INTEGRITY_KEY = "integrity"
 
 _sync_ckptr = None
 _async_ckptr = None
 _finalize_threads: list = []
+_atexit_registered = False
+# verification results primed by the save path (abspath -> (meta.json
+# mtime, state-tree total bytes, ok, reason)): a save that just digested
+# its own tree should not be re-hashed moments later by retention GC.
+# Keyed on meta mtime (a rewrite invalidates) AND total tree size (a
+# truncated/vanished shard invalidates via a cheap stat walk, no
+# hashing); opt-in per call (use_cache) because a cached verdict still
+# cannot see same-size bit rot after the save.
+_verify_cache: Dict[str, tuple] = {}
+
+
+def _register_exit_fence() -> None:
+    """Fence async saves at interpreter exit: the daemon ``_finalize``
+    thread dies with the interpreter, which would silently drop the last
+    async checkpoint's ``meta.json`` completion marker — the checkpoint
+    would exist on disk yet never count as complete."""
+    global _atexit_registered
+    if not _atexit_registered:
+        import atexit
+        atexit.register(wait_until_finished)
+        _atexit_registered = True
 
 
 def _checkpointer(async_save: bool):
@@ -46,6 +78,7 @@ def _checkpointer(async_save: bool):
         if _async_ckptr is None:
             _async_ckptr = ocp.AsyncCheckpointer(
                 ocp.StandardCheckpointHandler())
+            _register_exit_fence()
         return _async_ckptr
     if _sync_ckptr is None:
         _sync_ckptr = ocp.StandardCheckpointer()
@@ -66,13 +99,73 @@ def is_sharded_checkpoint(path: str) -> bool:
         os.path.join(path, META_FILE))
 
 
+def _tree_digests(path: str) -> Dict[str, Dict[str, Any]]:
+    """Per-file SHA-256 + size of everything under ``<path>/state/``
+    (relative paths).  File-level digests catch exactly what kills a
+    restore in practice — truncated shards, partial copies, bit rot —
+    without re-reading the arrays through orbax."""
+    state_dir = os.path.join(path, STATE_DIR)
+    files: Dict[str, Dict[str, Any]] = {}
+    for root, _dirs, names in os.walk(state_dir):
+        for name in sorted(names):
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, state_dir)
+            h = hashlib.sha256()
+            try:
+                with open(fp, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                files[rel] = {"sha256": h.hexdigest(),
+                              "bytes": os.path.getsize(fp)}
+            except OSError:
+                continue  # racing eviction; the dir-survival check rules
+    return files
+
+
+def _write_meta(path: str, metadata: Dict[str, Any]) -> None:
+    """meta.json LAST, with the integrity record, via tmp+rename: a
+    completed meta.json marks a complete AND digest-verifiable
+    checkpoint."""
+    meta = dict(metadata)
+    meta[INTEGRITY_KEY] = {"algo": "sha256",
+                           "files": _tree_digests(path)}
+    tmp = os.path.join(path, META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    meta_path = os.path.join(path, META_FILE)
+    os.replace(tmp, meta_path)
+    try:
+        # the digests were computed from the tree this instant: prime
+        # the cache so retention GC does not immediately re-hash it
+        total = sum(r["bytes"] for r in meta[INTEGRITY_KEY]["files"]
+                    .values())
+        _verify_cache[path] = (os.path.getmtime(meta_path), total,
+                               True, "ok")
+    except OSError:
+        pass
+
+
+def _tree_total_bytes(path: str) -> int:
+    """Stat-walk total of the state tree — the no-hash staleness probe
+    for cached verify verdicts."""
+    total = 0
+    for root, _dirs, names in os.walk(os.path.join(path, STATE_DIR)):
+        for name in names:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                continue
+    return total
+
+
 def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
                  async_save: bool = False) -> None:
     """Write ``state`` (a pytree of [possibly sharded] jax arrays) under
     ``path`` with every process writing its own shards.  ``metadata`` must
-    be JSON-serializable; it is written by process 0 only, LAST, so a
-    completed ``meta.json`` marks a complete checkpoint (torn writes are
-    invisible to ``is_sharded_checkpoint``/``latest_checkpoint``)."""
+    be JSON-serializable; it is written by process 0 only, LAST (with the
+    per-file integrity digests of the committed tree), so a completed
+    ``meta.json`` marks a complete checkpoint (torn writes are invisible
+    to ``is_sharded_checkpoint``/``latest_checkpoint``)."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = _checkpointer(async_save)
@@ -81,20 +174,28 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
                    args=ocp.args.StandardSave(state), force=True)
     else:
         ckptr.save(os.path.join(path, STATE_DIR), state, force=True)
+        # orbax's StandardCheckpointer subclasses AsyncCheckpointer (0.7.x):
+        # save() returns with the commit still on a background thread.  The
+        # sync contract here is "bytes are durable when save_sharded
+        # returns" -- the integrity digests (and any caller immediately
+        # reading the tree) depend on it, so fence explicitly.
+        wait = getattr(ckptr, "wait_until_finished", None)
+        if wait is not None:
+            wait()
     if jax.process_index() == 0:
         # the dir can transiently vanish between the array commit and this
         # write (observed rarely when a prior async save's eviction race
         # leaves cleanup work in flight in the same process); recreate
         # rather than crash the save
         os.makedirs(path, exist_ok=True)
-        tmp = os.path.join(path, META_FILE + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(metadata, f)
         if async_save:
-            # rename only once the array commit completes, from a tracked
-            # (joinable) thread: wait_until_finished() joins it, so a fenced
-            # checkpoint is guaranteed to carry its completion marker
+            # digest + write meta only once the array commit completes,
+            # from a tracked (joinable) thread: wait_until_finished() (and
+            # the atexit fence) joins it, so a fenced checkpoint is
+            # guaranteed to carry its completion marker
             import threading
+
+            meta_snapshot = dict(metadata)
 
             def _finalize():
                 _async_ckptr.wait_until_finished()
@@ -104,7 +205,7 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
                     # dir empty -- meta.json alone would make a state-less
                     # dir look like a restorable checkpoint)
                     if os.path.isdir(os.path.join(path, STATE_DIR)):
-                        os.replace(tmp, os.path.join(path, META_FILE))
+                        _write_meta(path, meta_snapshot)
                 except OSError:
                     pass  # checkpoint dir evicted while committing
 
@@ -125,12 +226,68 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
                         f"{path}")
                 ckptr.save(os.path.join(path, STATE_DIR), state,
                            force=True)
-            os.replace(tmp, os.path.join(path, META_FILE))
+                wait = getattr(ckptr, "wait_until_finished", None)
+                if wait is not None:
+                    wait()
+            _write_meta(path, metadata)
 
 
 def read_metadata(path: str) -> Dict[str, Any]:
     with open(os.path.join(path, META_FILE)) as f:
         return json.load(f)
+
+
+def verify_checkpoint(path: str, use_cache: bool = False) -> Tuple[bool, str]:
+    """Integrity pass over a sharded checkpoint dir: structure (state
+    tree present, meta.json parseable) plus the per-file digest record
+    when one exists.  Returns ``(ok, reason)`` — never raises.  A
+    checkpoint written before digests existed verifies on structure
+    alone (restores of it worked yesterday; refusing them today would
+    break every existing run dir).
+
+    ``use_cache=True`` accepts a verdict primed by this process's own
+    save of the same (unmodified, by meta.json mtime) checkpoint — for
+    hot paths like retention GC that would otherwise re-hash a
+    multi-GB tree right after writing it.  Restore-time verification
+    should keep the default full pass."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return False, "not a directory"
+    meta_path = os.path.join(path, META_FILE)
+    if not os.path.exists(meta_path):
+        return False, "meta.json missing (torn or in-flight save)"
+    if use_cache:
+        cached = _verify_cache.get(path)
+        try:
+            mtime = os.path.getmtime(meta_path)
+        except OSError:
+            mtime = None
+        if cached is not None and mtime is not None \
+                and cached[0] == mtime \
+                and cached[1] == _tree_total_bytes(path):
+            return cached[2], cached[3]
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (ValueError, OSError) as e:
+        return False, f"meta.json unreadable: {e}"
+    state_dir = os.path.join(path, STATE_DIR)
+    if not os.path.isdir(state_dir):
+        return False, "state tree missing"
+    integ = meta.get(INTEGRITY_KEY)
+    if not isinstance(integ, dict) or "files" not in integ:
+        return True, "ok (no integrity record; pre-digest checkpoint)"
+    actual = _tree_digests(path)
+    for rel, rec in integ["files"].items():
+        got = actual.get(rel)
+        if got is None:
+            return False, f"shard file missing: {rel}"
+        if rec.get("bytes") is not None and got["bytes"] != rec["bytes"]:
+            return False, (f"shard file truncated/resized: {rel} "
+                           f"({got['bytes']} != {rec['bytes']} bytes)")
+        if got["sha256"] != rec.get("sha256"):
+            return False, f"shard file digest mismatch: {rel}"
+    return True, "ok"
 
 
 def restore_sharded(path: str, template: Optional[Any] = None,
@@ -140,7 +297,10 @@ def restore_sharded(path: str, template: Optional[Any] = None,
     - ``template`` (a pytree matching the saved structure) makes restore
       structure-checked; with ``shardings`` (a matching pytree of
       ``NamedSharding``) each leaf comes back already device-put with that
-      sharding and each process reads only its shards.
+      sharding and each process reads only its shards.  The template's
+      GLOBAL shapes are what must match — the device count/mesh may
+      differ from the saving run's (elastic resume onto a shrunk pool):
+      the target shardings redistribute the saved bytes.
     - with neither, the tree comes back in saved structure on default
       devices (single-host convenience path).
     """

@@ -112,16 +112,28 @@ def _thread_leak_guard(request):
 @pytest.fixture(autouse=True)
 def _chaos_leak_guard(request):
     """``RLA_TPU_CHAOS`` makes every spawned worker crash/hang/stall on
-    purpose: ambient in the driver env it would poison EVERY fan-out in
-    the suite.  Only ``@pytest.mark.chaos`` tests may see it set, and no
-    test may leave it behind."""
-    is_chaos = request.node.get_closest_marker("chaos") is not None
-    if not is_chaos:
+    purpose (now including ``preempt@...``/``lost@...`` faults): ambient
+    in the driver env it would poison EVERY fan-out in the suite.  Only
+    ``@pytest.mark.chaos`` (or ``@pytest.mark.preempt``, whose tests
+    drive the preemption/lost-host kinds) tests may see it set, and no
+    test may leave it behind.  ``RLA_TPU_PREEMPT_GRACE_S`` gets the same
+    treatment: left ambient it would install SIGTERM notice handlers in
+    every spawned worker of unrelated tests."""
+    allowed = (request.node.get_closest_marker("chaos") is not None
+               or request.node.get_closest_marker("preempt") is not None)
+    if not allowed:
         assert "RLA_TPU_CHAOS" not in os.environ, (
             f"RLA_TPU_CHAOS leaked into non-chaos test {request.node.nodeid}"
-            " -- chaos specs belong in env_per_worker or a chaos-marked "
-            "test's monkeypatched env")
+            " -- chaos specs belong in env_per_worker or a chaos/preempt-"
+            "marked test's monkeypatched env")
+        assert "RLA_TPU_PREEMPT_GRACE_S" not in os.environ, (
+            f"RLA_TPU_PREEMPT_GRACE_S leaked into non-preempt test "
+            f"{request.node.nodeid} -- preemption grace belongs in "
+            "env_per_worker or a preempt-marked test's monkeypatched env")
     yield
     assert "RLA_TPU_CHAOS" not in os.environ, (
         f"{request.node.nodeid} left RLA_TPU_CHAOS set in the driver env; "
         "later fan-outs would inherit the fault injection")
+    assert "RLA_TPU_PREEMPT_GRACE_S" not in os.environ, (
+        f"{request.node.nodeid} left RLA_TPU_PREEMPT_GRACE_S set in the "
+        "driver env; later fan-outs would install preemption handlers")

@@ -106,16 +106,24 @@ def test_elastic_runner_gives_up():
         pool.shutdown()
 
 
-def test_latest_checkpoint_picks_newest(tmp_path):
+def test_latest_checkpoint_picks_newest_verified(tmp_path):
     assert ckpt_lib.latest_checkpoint(str(tmp_path)) is None
     a = tmp_path / "ckpts" / "epoch=0-step=8.ckpt"
     b = tmp_path / "ckpts" / "epoch=1-step=16.ckpt"
     a.parent.mkdir()
-    a.write_bytes(b"x")
-    b.write_bytes(b"y")
+    ckpt_lib.atomic_save({"global_step": 8}, str(a))
+    ckpt_lib.atomic_save({"global_step": 16}, str(b))
     os.utime(a, (1, 1))
     os.utime(b, (2, 2))
     assert ckpt_lib.latest_checkpoint(str(tmp_path)) == str(b)
+    # the newest is TORN (truncated pickle): the verified walk-back must
+    # fall back to the older readable one instead of handing it over
+    b.write_bytes(b.read_bytes()[:4])
+    os.utime(b, (2, 2))
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == str(a)
+    # verify=False restores the raw newest-by-mtime pick
+    assert ckpt_lib.latest_checkpoint(str(tmp_path),
+                                      verify=False) == str(b)
 
 
 def test_trainer_resume_last_continues_training(tmp_path):

@@ -78,7 +78,12 @@ def test_restore_with_shardings(tmp_path):
                              shardings={"w": sh, "b": sh})
     assert out["w"].sharding.spec == sh.spec
     np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(tree["w"]))
-    assert sc.read_metadata(path) == {"epoch": 1}
+    meta = sc.read_metadata(path)
+    assert meta["epoch"] == 1
+    # every save now carries the per-file integrity record, and the
+    # freshly written tree verifies against it
+    assert meta[sc.INTEGRITY_KEY]["algo"] == "sha256"
+    assert sc.verify_checkpoint(path) == (True, "ok")
 
 
 def test_unknown_format_rejected():
