@@ -516,6 +516,27 @@ def bench_decode() -> dict:
     }
 
 
+def _last_metric_record(stdout: str):
+    """Newest JSON line of probe stdout that is an actual METRIC record
+    (has a ``value`` key) -- probes also emit bench-honesty compile-count
+    records, which must never displace the metric.  Falls back to the
+    newest JSON line of any kind so probe error records still surface."""
+    fallback = None
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "value" in rec:
+            return rec
+        if fallback is None:
+            fallback = rec
+    return fallback
+
+
 def bench_gradexchange() -> dict:
     """Gradient-exchange microbench (fp32 implicit-psum vs int8/bf16
     quantized allreduce, parallel/collectives.py): step time + bytes
@@ -535,11 +556,10 @@ def bench_gradexchange() -> dict:
         raise RuntimeError(
             f"gradexchange probe failed (rc {proc.returncode}): "
             + " | ".join(tail))
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError("gradexchange probe produced no JSON record")
+    rec = _last_metric_record(proc.stdout)
+    if rec is None:
+        raise RuntimeError("gradexchange probe produced no JSON record")
+    return rec
 
 
 def bench_input_pipeline() -> dict:
@@ -559,11 +579,10 @@ def bench_input_pipeline() -> dict:
         raise RuntimeError(
             f"input_pipeline probe failed (rc {proc.returncode}): "
             + " | ".join(tail))
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError("input_pipeline probe produced no JSON record")
+    rec = _last_metric_record(proc.stdout)
+    if rec is None:
+        raise RuntimeError("input_pipeline probe produced no JSON record")
+    return rec
 
 
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,

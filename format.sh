@@ -33,3 +33,8 @@ else
     echo "$FILES" | xargs python -m py_compile
     echo "format.sh: flake8 not installed; byte-compile check passed"
 fi
+
+# graftlint: the JAX-aware invariant checks (host syncs in hot paths,
+# retrace hazards, knob/wire registry drift) — exits nonzero on findings
+python scripts/graftlint.py ray_lightning_accelerators_tpu
+echo "format.sh: graftlint clean"

@@ -1,0 +1,128 @@
+"""compile-guard: count XLA backend compiles and budget them in tests.
+
+graftlint catches retrace hazards statically; this module catches the
+ones only the runtime can see.  It subscribes one process-global
+listener to ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` event — fired exactly
+once per backend compile, never on an executable-cache hit — and keeps
+a monotonic counter.  A guard block then turns prose into an assertion:
+
+    with compile_guard(max_new_compiles=3) as g:
+        ...serve a staggered join/retire workload...
+    # raises CompileBudgetExceeded past the budget; g.new_compiles holds
+    # the actual count either way
+
+The serve engine's "three compiled programs" lifecycle and the
+trainer's "compile once, never retrace after warmup" are pinned this
+way in ``tests/test_analysis.py``; the bench probes emit
+``compile_count()`` deltas alongside their metric lines so a retrace
+regression shows up in the bench trajectory even when nothing asserts.
+
+Counting is process-global (jax's compile cache is too): guards see
+compiles from ALL threads, including the serve engine's decode thread —
+which is the point.  Guard blocks therefore should not overlap
+unrelated concurrent compilation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+def _on_event_duration(event: str, *args, **kwargs) -> None:
+    global _count
+    if event == BACKEND_COMPILE_EVENT:
+        with _lock:
+            _count += 1
+
+
+def install() -> None:
+    """Idempotently register the counting listener.  jax.monitoring has
+    no per-listener deregistration, so ONE listener is installed for the
+    process lifetime and guards snapshot the counter around blocks.
+    The flag flips only AFTER successful registration: a one-time
+    import/registration failure must raise on every call, not silently
+    freeze the counter at zero (which would make every guard pass
+    vacuously)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Backend compiles observed since ``install()`` (monotonic).  The
+    first call installs the listener, so deltas are only meaningful
+    between calls AFTER the first."""
+    install()
+    with _lock:
+        return _count
+
+
+class CompileBudgetExceeded(AssertionError):
+    """A guarded block compiled more programs than its budget."""
+
+
+class compile_guard:
+    """Context manager asserting a compile budget over a block.
+
+    ``max_new_compiles=None`` only records (``.new_compiles`` after
+    exit).  On budget violation raises ``CompileBudgetExceeded`` —
+    unless the block is already unwinding with its own exception, which
+    must not be masked."""
+
+    def __init__(self, max_new_compiles: Optional[int] = None,
+                 label: str = ""):
+        self.max_new_compiles = max_new_compiles
+        self.label = label
+        self.start_count: Optional[int] = None
+        self.new_compiles: Optional[int] = None
+
+    def __enter__(self) -> "compile_guard":
+        self.start_count = compile_count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.new_compiles = compile_count() - self.start_count
+        if exc_type is None and self.max_new_compiles is not None \
+                and self.new_compiles > self.max_new_compiles:
+            what = f" [{self.label}]" if self.label else ""
+            raise CompileBudgetExceeded(
+                f"compile budget exceeded{what}: {self.new_compiles} new "
+                f"XLA backend compiles in a block budgeted for "
+                f"{self.max_new_compiles} — something is retracing "
+                "(see graftlint's retrace rule for the usual suspects)")
+        return False
+
+
+def assert_no_new_compiles(label: str = "") -> compile_guard:
+    """Sugar for the steady-state invariant: zero compiles after
+    warmup."""
+    return compile_guard(max_new_compiles=0, label=label)
+
+
+def compile_count_record(probe: str,
+                         window_start: Optional[int] = None) -> dict:
+    """The bench-honesty tie-in line: probe scripts print this JSON
+    record alongside their metric line, so a retrace regression is
+    visible in the bench trajectory even when no test asserts on it.
+    ``window_start`` (a ``compile_count()`` snapshot taken after warmup)
+    adds the measured-window delta — 0 in a healthy run."""
+    total = compile_count()
+    rec = {"probe": probe, "kind": "compile_count",
+           "total_backend_compiles": total}
+    if window_start is not None:
+        rec["measured_window_compiles"] = total - window_start
+    return rec

@@ -1,0 +1,232 @@
+"""The ``RLA_TPU_*`` environment-knob registry and its typed getters.
+
+Every env knob the package reads is declared here — name, type, default,
+and one-line help — and read through a typed getter.  The contract
+(PR 5's warn-and-default behavior, made the checked norm):
+
+- **malformed values never crash**: a bad ``RLA_TPU_FLASH_BLOCK_Q=abc``
+  logs one warning and falls back to the default, instead of raising
+  deep inside a trace or at import time;
+- **unregistered names never parse silently**: a getter called with a
+  name missing from ``KNOBS`` raises ``LookupError`` — registering here
+  is the one-line cost of adding a knob, and graftlint's
+  ``knob-registry`` rule statically rejects raw ``os.environ`` reads of
+  ``RLA_TPU_*`` names anywhere else in the package;
+- **per-worker overlays**: runtime code that honors a per-worker env
+  dict before the process env (watchdog heartbeats, preemption grace)
+  passes it as ``env=`` — the overlay wins when it has the key.
+
+This module is a dependency leaf (stdlib only): ``utils.logging`` and
+the runtime modules import it, never the reverse.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Dict, Mapping, Optional
+
+# child of the package logger (utils/logging.py configures the parent's
+# handler); importing utils.logging here would be circular, since the
+# log-level knob itself is read through this registry
+log = logging.getLogger("ray_lightning_accelerators_tpu.knobs")
+
+KINDS = ("str", "int", "float", "bool", "flag")
+
+# values get_bool accepts; anything else warns and uses the default
+_TRUE = frozenset(("1", "true", "yes", "on"))
+_FALSE = frozenset(("0", "false", "no", "off", ""))
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob.
+
+    ``kind``: parse discipline — ``flag`` is presence-truthiness (any
+    non-empty value enables, matching ``os.environ.get(X)`` gates),
+    ``bool`` parses 1/true/yes/on vs 0/false/no/off.  ``default`` is
+    documentation of the effective default; call sites may pass their
+    own (module constants stay authoritative).  ``scope``: where the
+    knob is read — ``package`` knobs are enforced by graftlint; tests/
+    scripts knobs are registered for the docs table and tooling."""
+
+    name: str
+    kind: str
+    default: object
+    help: str
+    scope: str = "package"
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _register(knob: Knob) -> Knob:
+    if knob.kind not in KINDS:
+        raise ValueError(f"unknown knob kind {knob.kind!r} for {knob.name}")
+    if knob.name in KNOBS:
+        raise ValueError(f"duplicate knob registration: {knob.name}")
+    KNOBS[knob.name] = knob
+    return knob
+
+
+# --------------------------------------------------------------------- #
+# Registry (alphabetical).  graftlint extracts these names statically   #
+# (Knob("LITERAL", ...)), so names must stay string literals.           #
+# --------------------------------------------------------------------- #
+_register(Knob("RLA_TPU_AGENTS", "str", "",
+               "comma-separated host:port agent list for the multi-host "
+               "driver (runtime/agent.py; also set by the CLI)"))
+_register(Knob("RLA_TPU_AGENT_CONNECT_TIMEOUT", "float", 30.0,
+               "seconds to keep retrying an unreachable agent while it "
+               "boots (runtime/agent.py)"))
+_register(Knob("RLA_TPU_AGENT_TOKEN", "str", "",
+               "shared secret authenticating driver<->agent connections "
+               "(runtime/agent.py)"))
+_register(Knob("RLA_TPU_ALLOW_TOKENLESS_BIND", "bool", False,
+               "allow an agent to bind without RLA_TPU_AGENT_TOKEN "
+               "(loopback/dev only; runtime/agent.py)"))
+_register(Knob("RLA_TPU_BENCH_CHILD", "flag", False,
+               "marks a bench.py isolation child so mid-run death "
+               "fallbacks emit once, in the parent (bench.py)",
+               scope="scripts"))
+_register(Knob("RLA_TPU_CHAOS", "str", "",
+               "deterministic fault-injection spec, e.g. "
+               "'hang@rank1:step2' (testing/chaos.py; conftest guards "
+               "it outside chaos-marked tests)"))
+_register(Knob("RLA_TPU_CHAOS_NS", "str", "",
+               "namespace directory keying once-across-restart chaos "
+               "token files (testing/chaos.py)"))
+_register(Knob("RLA_TPU_DISABLE_PALLAS", "flag", False,
+               "disable the pallas flash-attention / fused-norm kernels "
+               "(ops/attention.py, ops/norms.py)"))
+_register(Knob("RLA_TPU_DISABLE_Q8_KERNEL", "flag", False,
+               "disable the int8 matmul decode kernel "
+               "(models/transformer.py)"))
+_register(Knob("RLA_TPU_ELASTIC_BACKOFF_S", "float", 0.0,
+               "base seconds for ElasticRunner's exponential "
+               "restart backoff; <=0 disables (runtime/elastic.py)"))
+_register(Knob("RLA_TPU_ELASTIC_BACKOFF_CAP_S", "float", 60.0,
+               "cap on the exponential restart backoff "
+               "(runtime/elastic.py)"))
+_register(Knob("RLA_TPU_FLASH_BLOCK_Q", "int", 512,
+               "flash-attention q block size, read at trace time "
+               "(ops/attention.py)"))
+_register(Knob("RLA_TPU_FLASH_BLOCK_K", "int", 512,
+               "flash-attention k block size, read at trace time "
+               "(ops/attention.py)"))
+_register(Knob("RLA_TPU_GLOBAL_SEED", "int", None,
+               "global seed honored by seed_everything(); exported to "
+               "children (utils/seed.py)"))
+_register(Knob("RLA_TPU_INSIDE_WORKER", "bool", False,
+               "set in spawned workers so nested code never re-launches "
+               "a world (core/trainer.py, runtime)"))
+_register(Knob("RLA_TPU_LOG_LEVEL", "str", "WARNING",
+               "package logger level; unknown names warn and default "
+               "(utils/logging.py)"))
+_register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
+               "multi-process drain-consensus cadence in steps "
+               "(core/trainer.py)"))
+_register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
+               "preemption grace budget in seconds; setting it installs "
+               "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_TEST_PLATFORM", "str", "cpu",
+               "platform the test suite binds (tests/conftest.py); "
+               "'tpu' gates real-chip runs", scope="tests"))
+_register(Knob("RLA_TPU_WEDGE_TIMEOUT_S", "float", None,
+               "stale-heartbeat threshold; setting it arms the watchdog "
+               "(runtime/watchdog.py)"))
+_register(Knob("RLA_TPU_WORKER_HEARTBEAT_S", "float", 1.0,
+               "worker heartbeat interval; <=0 disables the channel "
+               "(runtime/watchdog.py)"))
+_register(Knob("RLA_TPU_WORKER_PLATFORM", "str", None,
+               "jax platform forced onto spawned workers "
+               "(core/trainer.py)"))
+
+
+def registered_names() -> frozenset:
+    return frozenset(KNOBS)
+
+
+# --------------------------------------------------------------------- #
+# Typed getters                                                          #
+# --------------------------------------------------------------------- #
+_MISSING = object()
+
+
+def _lookup(name: str, env: Optional[Mapping[str, str]]) -> Optional[str]:
+    """Raw value: per-worker overlay first (when it HAS the key), then
+    the process env; None when unset in both.  Also the registration
+    gate: every read funnels through here."""
+    if name not in KNOBS:
+        raise LookupError(
+            f"env knob {name!r} is not registered in analysis/knobs.py; "
+            "declare it (name, type, default, help) before reading it")
+    if env is not None and name in env:
+        return env[name]
+    return os.environ.get(name)
+
+
+def get_raw(name: str, env: Optional[Mapping[str, str]] = None
+            ) -> Optional[str]:
+    """The unparsed string, or None when unset — for presence gates and
+    pass-through values (chaos specs, platform names, tokens)."""
+    return _lookup(name, env)
+
+
+def get_str(name: str, default: Optional[str] = None,
+            env: Optional[Mapping[str, str]] = None) -> Optional[str]:
+    raw = _lookup(name, env)
+    return default if raw in (None, "") else raw
+
+
+def get_int(name: str, default: Optional[int] = None, *,
+            malformed=_MISSING,
+            env: Optional[Mapping[str, str]] = None) -> Optional[int]:
+    """``default`` when unset/empty; ``malformed`` (defaults to
+    ``default``) with one warning when set but unparseable."""
+    raw = _lookup(name, env)
+    if raw in (None, ""):
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        fallback = default if malformed is _MISSING else malformed
+        log.warning("bad %s=%r; using %r", name, raw, fallback)
+        return fallback
+
+
+def get_float(name: str, default: Optional[float] = None, *,
+              malformed=_MISSING,
+              env: Optional[Mapping[str, str]] = None) -> Optional[float]:
+    raw = _lookup(name, env)
+    if raw in (None, ""):
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        fallback = default if malformed is _MISSING else malformed
+        log.warning("bad %s=%r; using %r", name, raw, fallback)
+        return fallback
+
+
+def get_bool(name: str, default: bool = False,
+             env: Optional[Mapping[str, str]] = None) -> bool:
+    raw = _lookup(name, env)
+    if raw is None:
+        return default
+    v = raw.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    log.warning("bad %s=%r (expected 1/0/true/false); using %r",
+                name, raw, default)
+    return default
+
+
+def get_flag(name: str, env: Optional[Mapping[str, str]] = None) -> bool:
+    """Presence-truthiness: any non-empty value enables.  Matches the
+    historical ``if os.environ.get(X):`` gates (so ``X=0`` ENABLES a
+    flag knob — use ``bool`` kind for new knobs that want parsing)."""
+    return bool(_lookup(name, env))

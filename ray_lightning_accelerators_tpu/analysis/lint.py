@@ -1,0 +1,519 @@
+"""graftlint: JAX-aware AST analysis over this package.
+
+The driver: file discovery, per-module parsing (AST + pragma comments +
+module-level string constants + import aliases), the package-wide
+resolution tables the rules share, and the report/exit-code surface the
+CLI (``scripts/graftlint.py``) and the test suite use.
+
+Rules live in ``analysis/rules/`` (one module per rule; see
+``rules/__init__.py`` for the catalog).  Each rule yields ``Finding``s;
+a finding is suppressed by an inline pragma on its line (or the line
+directly above, for findings inside multi-line expressions)::
+
+    nxt = np.asarray(tok)  # graftlint: ok(host-sync) — feed gate: the
+                           # next step needs this token on the host
+
+The pragma REQUIRES a reason after the rule list — a bare ``ok(...)``
+is itself reported (rule ``pragma``), so every deliberate violation
+documents why it is deliberate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterable, List, Mapping, Optional, Set, Tuple
+
+# --------------------------------------------------------------------- #
+# Findings & pragmas                                                     #
+# --------------------------------------------------------------------- #
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # module key (package-relative posix path)
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}{tag}")
+
+
+# "# graftlint: ok(rule-a, rule-b) — reason" / "- reason" / ": reason"
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*ok\(([^)]*)\)\s*(?:[—–:-]\s*(.*))?$")
+
+
+def _parse_pragmas(lines: List[str]) -> Tuple[Dict[int, Set[str]],
+                                              List[int]]:
+    """line (1-based) -> suppressed rules; plus lines whose pragma has
+    no reason (reported as rule 'pragma')."""
+    pragmas: Dict[int, Set[str]] = {}
+    missing_reason: List[int] = []
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rules = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        pragmas[i] = rules
+        if not (m.group(2) or "").strip():
+            missing_reason.append(i)
+    return pragmas, missing_reason
+
+
+# --------------------------------------------------------------------- #
+# Per-module parse                                                       #
+# --------------------------------------------------------------------- #
+
+@dataclass
+class ModuleInfo:
+    key: str                     # package-relative posix path
+    tree: ast.Module
+    lines: List[str]
+    pragmas: Dict[int, Set[str]]
+    pragma_missing_reason: List[int]
+    consts: Dict[str, str] = field(default_factory=dict)
+    # alias -> module key ("import x.y as z" / "from ..r import m as z")
+    mod_aliases: Dict[str, str] = field(default_factory=dict)
+    # local name -> (module key, original name) for "from m import NAME"
+    imported_names: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+
+def _module_pkg_parts(key: str) -> List[str]:
+    """Package path of a module key: 'runtime/agent.py' -> ['runtime']."""
+    parts = key.split("/")[:-1]
+    if key.endswith("/__init__.py"):
+        parts = parts[:-1]
+    return parts
+
+
+def _resolve_import(key: str, node_module: Optional[str],
+                    level: int) -> Optional[str]:
+    """Module key a (possibly relative) import refers to, or None when it
+    leaves the linted tree (absolute third-party imports)."""
+    if level == 0:
+        return None  # absolute: stdlib/third-party (or self-absolute; skip)
+    base = _module_pkg_parts(key)
+    if level - 1 > len(base):
+        return None
+    if level > 1:
+        base = base[:len(base) - (level - 1)]
+    mod = (node_module or "").split(".") if node_module else []
+    return "/".join(base + mod) + ".py"
+
+
+def parse_module(key: str, source: str) -> ModuleInfo:
+    tree = ast.parse(source, filename=key)
+    lines = source.splitlines()
+    pragmas, missing = _parse_pragmas(lines)
+    info = ModuleInfo(key=key, tree=tree, lines=lines, pragmas=pragmas,
+                      pragma_missing_reason=missing)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            info.consts[node.targets[0].id] = node.value.value
+        elif isinstance(node, ast.ImportFrom):
+            target = _resolve_import(key, node.module, node.level)
+            for alias in node.names:
+                local = alias.asname or alias.name
+                if target is None:
+                    continue
+                # "from ..runtime import preemption as preempt_lib":
+                # the imported NAME may itself be a module of the tree
+                submodule = target[:-3] + "/" + alias.name + ".py" \
+                    if target.endswith(".py") else None
+                info.mod_aliases[local] = submodule or target
+                info.imported_names[local] = (target, alias.name)
+    return info
+
+
+# --------------------------------------------------------------------- #
+# Shared AST helpers (used by the rule modules)                          #
+# --------------------------------------------------------------------- #
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_str(ctx: "LintContext", module: ModuleInfo,
+                node: ast.AST) -> Optional[str]:
+    """A string the expression statically evaluates to: literals,
+    module-level constants, and imported/attribute constants from other
+    modules of the linted tree."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in module.consts:
+            return module.consts[node.id]
+        imp = module.imported_names.get(node.id)
+        if imp is not None:
+            target = ctx.modules.get(imp[0])
+            # "from .watchdog import HEARTBEAT_ENV"
+            if target is not None and imp[1] in target.consts:
+                return target.consts[imp[1]]
+            # the name may BE a submodule; no string value then
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        modkey = module.mod_aliases.get(node.value.id)
+        if modkey is not None:
+            target = ctx.modules.get(modkey)
+            if target is not None:
+                return target.consts.get(node.attr)
+    return None
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """A call that constructs a compiled-function boundary:
+    jax.jit / jit / pjit / shard_map (any dotted spelling)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    if name is None:
+        return False
+    leaf = name.split(".")[-1]
+    return leaf in ("jit", "pjit", "shard_map")
+
+
+def function_table(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Call-resolvable functions of a module: top-level defs ('name') and
+    class methods ('Class.name').  Nested defs are not call-resolvable
+    by name from other functions and stay out of the table."""
+    table: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    table[f"{node.name}.{sub.name}"] = sub
+    return table
+
+
+def _call_edges(fn: ast.AST, cls: Optional[str]) -> Set[str]:
+    """Qualnames this function may call within its module: self.m() ->
+    'Class.m', bare f() -> 'f'."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and cls:
+            out.add(f"{cls}.{f.attr}")
+        elif isinstance(f, ast.Name):
+            out.add(f.id)
+    return out
+
+
+def reachable_functions(module: ModuleInfo,
+                        roots: Iterable[str]) -> Dict[str, ast.AST]:
+    """Transitive closure of the within-module call graph from root
+    qualnames ('Class.method' / 'func').  Cross-module calls and
+    unresolvable attribute calls are not followed — hot-path configs
+    list roots per module instead."""
+    table = function_table(module.tree)
+    seen: Dict[str, ast.AST] = {}
+    stack = [r for r in roots if r in table]
+    while stack:
+        qn = stack.pop()
+        if qn in seen:
+            continue
+        seen[qn] = table[qn]
+        cls = qn.split(".")[0] if "." in qn else None
+        for callee in _call_edges(table[qn], cls):
+            if callee in table and callee not in seen:
+                stack.append(callee)
+    return seen
+
+
+def jitted_attr_names(tree: ast.Module) -> Dict[str, Set[str]]:
+    """class name -> self attributes assigned from a jit construction
+    (``self._step = jax.jit(...)``, including dict-slot assignment
+    ``self._prefills[k] = jax.jit(...)``) — calls through these attrs
+    return device arrays."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names: Set[str] = set()
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or not is_jit_call(sub.value):
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Subscript):
+                    tgt = tgt.value
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    names.add(tgt.attr)
+        if names:
+            out[node.name] = names
+    return out
+
+
+def jitted_local_defs(scope: ast.AST) -> Dict[str, Tuple[ast.AST, Set[str]]]:
+    """Defs in ``scope``'s immediate body that become jitted callables:
+    decorated with jit/pjit (bare or via functools.partial), or passed
+    by name to a jit construction in the same scope.  Returns
+    name -> (def node, static param names)."""
+    defs: Dict[str, ast.AST] = {}
+    static: Dict[str, Set[str]] = {}
+    body = getattr(scope, "body", [])
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    def static_names(call: ast.Call, fn: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        params = [a.arg for a in fn.args.args]
+        for kw in call.keywords:
+            v = kw.value
+            if kw.arg == "static_argnames":
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                names |= {e.value for e in elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)}
+            elif kw.arg == "static_argnums":
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for e in elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, int) \
+                            and e.value < len(params):
+                        names.add(params[e.value])
+        return names
+
+    out: Dict[str, Tuple[ast.AST, Set[str]]] = {}
+    for name, fn in defs.items():
+        for dec in fn.decorator_list:
+            if is_jit_call_name(dec):  # @jax.jit
+                out[name] = (fn, set())
+                break
+            if not isinstance(dec, ast.Call):
+                continue
+            if is_jit_call(dec):  # @jax.jit(static_argnames=...)
+                out[name] = (fn, static_names(dec, fn))
+                break
+            dn = dotted(dec.func)
+            if dn and dn.split(".")[-1] == "partial" and dec.args \
+                    and is_jit_call_name(dec.args[0]):
+                out[name] = (fn, static_names(dec, fn))  # @partial(jit, ...)
+                break
+    # jax.jit(fn_name, ...) in the same scope
+    for node in body:
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call) and is_jit_call(call) \
+                    and call.args and isinstance(call.args[0], ast.Name) \
+                    and call.args[0].id in defs:
+                fn = defs[call.args[0].id]
+                out[call.args[0].id] = (fn, static_names(call, fn))
+    return out
+
+
+def is_jit_call_name(node: ast.AST) -> bool:
+    name = dotted(node)
+    return bool(name) and name.split(".")[-1] in ("jit", "pjit", "shard_map")
+
+
+# --------------------------------------------------------------------- #
+# Config & context                                                       #
+# --------------------------------------------------------------------- #
+
+# the functions whose transitive (within-module) closure is "the hot
+# path": one optimizer step and one decode cycle must stay sync-free
+DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
+    "core/trainer.py": ("Trainer._fit_step", "Trainer._run_scanned_epoch",
+                        "Trainer._place_train_item"),
+    "serve/engine.py": ("ServeEngine._run",),
+    "utils/profiler.py": ("Profiler.span",),
+}
+
+# modules whose code runs inside dispatched workers: typed exceptions
+# raised here cross the pipe as (name, message, tb) and must be
+# rebuildable (runtime/wire.py)
+DEFAULT_WORKER_MODULES: Tuple[str, ...] = (
+    "runtime/actors.py", "runtime/bootstrap.py", "runtime/elastic.py",
+    "runtime/object_store.py", "runtime/preemption.py", "runtime/queue.py",
+    "runtime/session.py", "runtime/watchdog.py", "core/trainer.py",
+    "testing/chaos.py",
+)
+
+
+@dataclass(frozen=True)
+class LintConfig:
+    knob_names: frozenset = frozenset()
+    wire_names: frozenset = frozenset()
+    hot_roots: Mapping[str, Tuple[str, ...]] = field(
+        default_factory=lambda: dict(DEFAULT_HOT_ROOTS))
+    worker_modules: Tuple[str, ...] = DEFAULT_WORKER_MODULES
+    # file (module key) the knob registry lives in: exempt from the
+    # raw-environ rule (it IS the sanctioned reader)
+    knobs_module: str = "analysis/knobs.py"
+    wire_module: str = "runtime/wire.py"
+
+    @classmethod
+    def for_tree(cls, files: Mapping[str, str]) -> "LintConfig":
+        """Config with knob/wire registries extracted statically from the
+        tree being linted (no package import needed)."""
+        cfg = cls()
+        knobs_src = files.get(cfg.knobs_module)
+        if knobs_src is not None:
+            cfg = replace(cfg, knob_names=_knob_names_from_source(knobs_src))
+        wire_src = files.get(cfg.wire_module)
+        if wire_src is not None:
+            cfg = replace(cfg, wire_names=_wire_names_from_source(wire_src))
+        return cfg
+
+
+def _knob_names_from_source(source: str) -> frozenset:
+    """Names from Knob("LITERAL", ...) declarations."""
+    names = set()
+    for node in ast.walk(ast.parse(source)):
+        if isinstance(node, ast.Call) and dotted(node.func) and \
+                dotted(node.func).split(".")[-1] == "Knob" and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                names.add(first.value)
+    return frozenset(names)
+
+
+def _wire_names_from_source(source: str) -> frozenset:
+    """String literals of the WIRE_EXCEPTION_NAMES set."""
+    for node in ast.walk(ast.parse(source)):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "WIRE_EXCEPTION_NAMES":
+            return frozenset(
+                c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str))
+    return frozenset()
+
+
+@dataclass
+class LintContext:
+    config: LintConfig
+    modules: Dict[str, ModuleInfo]
+
+
+# --------------------------------------------------------------------- #
+# Driver                                                                 #
+# --------------------------------------------------------------------- #
+
+def discover(root: str) -> Dict[str, str]:
+    """module key -> source for every .py under ``root`` (a package dir
+    or a standalone file — files inside a package are handled by
+    ``lint_path``, which lints the whole enclosing package so the
+    path-keyed rule configs and registries resolve)."""
+    files: Dict[str, str] = {}
+    root = os.path.abspath(root)
+    if os.path.isfile(root):
+        with open(root, encoding="utf-8") as f:
+            files[os.path.basename(root)] = f.read()
+        return files
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            key = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                files[key] = f.read()
+    return files
+
+
+def run_lint(files: Mapping[str, str],
+             config: Optional[LintConfig] = None) -> List[Finding]:
+    """Lint in-memory sources (module key -> source).  Returns ALL
+    findings; suppressed ones carry ``suppressed=True``."""
+    from . import rules as rules_pkg
+
+    if config is None:
+        config = LintConfig.for_tree(files)
+    modules: Dict[str, ModuleInfo] = {}
+    findings: List[Finding] = []
+    for key, source in files.items():
+        try:
+            modules[key] = parse_module(key, source)
+        except SyntaxError as e:
+            findings.append(Finding("parse", key, e.lineno or 0, 0,
+                                    f"syntax error: {e.msg}"))
+    ctx = LintContext(config=config, modules=modules)
+    for module in modules.values():
+        for line in module.pragma_missing_reason:
+            findings.append(Finding(
+                "pragma", module.key, line, 0,
+                "graftlint pragma without a reason — write "
+                "'# graftlint: ok(<rule>) — <why this is deliberate>'"))
+        for rule in rules_pkg.ALL_RULES:
+            findings.extend(rule.check(module, ctx))
+    # inline suppression: pragma on the finding's line or the line above
+    for f in findings:
+        if f.rule == "pragma":
+            continue
+        module = modules.get(f.path)
+        if module is None:
+            continue
+        for line in (f.line, f.line - 1):
+            if f.rule in module.pragmas.get(line, ()):  # noqa: SIM110
+                f.suppressed = True
+                break
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _package_root(path: str) -> Optional[str]:
+    """Topmost enclosing package dir of a .py file (walk up while
+    ``__init__.py`` exists), or None for a standalone file."""
+    d = os.path.dirname(os.path.abspath(path))
+    if not os.path.exists(os.path.join(d, "__init__.py")):
+        return None
+    while os.path.exists(os.path.join(os.path.dirname(d), "__init__.py")):
+        d = os.path.dirname(d)
+    return d
+
+
+def lint_path(root: str,
+              config: Optional[LintConfig] = None) -> List[Finding]:
+    root_abs = os.path.abspath(root)
+    if os.path.isfile(root_abs):
+        pkg = _package_root(root_abs)
+        if pkg is not None:
+            # a file INSIDE a package: lint the whole enclosing package
+            # (hot-root/worker-module keys, the knob/wire registries and
+            # cross-module constants all resolve exactly as in a package
+            # run — a basename key would silently no-op every path-keyed
+            # rule and report a false clean), then report only the
+            # requested file's findings
+            key = os.path.relpath(root_abs, pkg).replace(os.sep, "/")
+            return [f for f in run_lint(discover(pkg), config)
+                    if f.path == key]
+    return run_lint(discover(root), config)
+
+
+def report(findings: List[Finding], verbose: bool = False) -> Tuple[str, int]:
+    """(text, exit code): nonzero iff any unsuppressed finding."""
+    active = [f for f in findings if not f.suppressed]
+    lines = [f.format() for f in active]
+    if verbose:
+        lines += [f.format() for f in findings if f.suppressed]
+    n_sup = sum(f.suppressed for f in findings)
+    lines.append(f"graftlint: {len(active)} finding(s), "
+                 f"{n_sup} suppressed by pragma")
+    return "\n".join(lines), (1 if active else 0)

@@ -1,0 +1,20 @@
+"""graftlint rule catalog — one module per rule.
+
+Each rule module exposes ``RULE`` (the name pragmas reference) and
+``check(module, ctx) -> Iterable[Finding]``.
+
+- ``host-sync``      device->host synchronization in a hot path
+- ``retrace``        recompilation hazards at jit/shard_map boundaries
+- ``tracer-leak``    traced values escaping a jitted function
+- ``knob-registry``  RLA_TPU_* env reads outside the knobs registry
+- ``wire-exception`` typed raises in worker code missing from the wire
+                     reconstruction registry
+"""
+
+from . import (host_sync, knob_registry, retrace, tracer_leak,
+               wire_exceptions)
+
+ALL_RULES = (host_sync, retrace, tracer_leak, knob_registry,
+             wire_exceptions)
+
+RULE_NAMES = tuple(r.RULE for r in ALL_RULES)

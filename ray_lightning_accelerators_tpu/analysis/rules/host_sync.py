@@ -1,0 +1,166 @@
+"""Rule ``host-sync``: device->host synchronization in a hot path.
+
+The single biggest silent perf killer in a JAX program is a host sync
+inside the step/decode loop: one ``.item()``, ``float(loss)``,
+``np.asarray(logits)`` or ``jax.device_get`` turns XLA's async dispatch
+pipeline into lock-step host<->device ping-pong, erasing exactly the
+wins PR 3 (compressed collectives) and PR 4 (async input pipeline)
+measured.  The trainer/serve prose promises the hot loops stay
+dispatch-async; this rule enforces it.
+
+Scope: the transitive within-module call closure of the configured hot
+roots (``LintConfig.hot_roots`` — ``Trainer._fit_step``, the scanned
+epoch, the serve decode loop, profiler spans).  Flagged:
+
+- ``x.item()`` and ``x.block_until_ready()``
+- ``jax.device_get(...)`` / ``jax.block_until_ready(...)``
+- ``np.asarray(...)`` / ``np.array(...)`` (any numpy alias) — on a
+  device array these block until the value is real
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` where ``x`` involves a value
+  produced by a jnp/jax call or a jitted callable in the same function
+  (local dataflow; conservative, so host-side numpy stays un-flagged)
+
+Deliberate syncs (a serve feed gate, log-interval-gated metrics
+materialization) carry an inline pragma with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..lint import (Finding, LintContext, ModuleInfo, dotted,
+                    jitted_attr_names, jitted_local_defs,
+                    reachable_functions)
+
+RULE = "host-sync"
+
+_NUMPY_MODULES = ("numpy", "np", "onp")
+_ARRAY_PRODUCER_PREFIXES = ("jnp.", "jax.", "lax.", "jax.numpy.")
+
+
+def _numpy_aliases(module: ModuleInfo) -> Set[str]:
+    """Local names bound to the numpy module."""
+    aliases = {"numpy"}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    aliases.update(n for n in _NUMPY_MODULES)
+    return aliases
+
+
+def _jnp_call(node: ast.AST, jitted_attrs: Set[str]) -> bool:
+    """Does this expression contain a call producing a device array —
+    a jnp./jax./lax. call or a call through a jitted self-attribute
+    (``self._step(...)``, ``self._prefills[k](...)``)?"""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted(sub.func)
+        if name and (name.startswith(_ARRAY_PRODUCER_PREFIXES)
+                     or name.split(".")[0] in ("jnp", "lax")):
+            return True
+        f = sub.func
+        if isinstance(f, ast.Subscript):
+            f = f.value
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and f.attr in jitted_attrs:
+            return True
+    return False
+
+
+def _arrayish_names(fn: ast.AST, jitted_attrs: Set[str]) -> Set[str]:
+    """Names assigned (anywhere in the function) from device-array
+    producing expressions.  One forward pass — good enough for
+    straight-line hot loops, and conservative by construction."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _jnp_call(node.value,
+                                                     jitted_attrs):
+            for tgt in node.targets:
+                elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        names.add(e.id)
+    return names
+
+
+def _mentions_arrayish(node: ast.AST, arrayish: Set[str],
+                       jitted_attrs: Set[str]) -> bool:
+    if _jnp_call(node, jitted_attrs):
+        return True
+    return any(isinstance(sub, ast.Name) and sub.id in arrayish
+               for sub in ast.walk(node))
+
+
+def check(module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+    roots = None
+    for suffix, qualnames in ctx.config.hot_roots.items():
+        if module.key == suffix or module.key.endswith("/" + suffix):
+            roots = qualnames
+            break
+    if roots is None:
+        return []
+    hot = reachable_functions(module, roots)
+    if not hot:
+        return []
+    np_aliases = _numpy_aliases(module)
+    jit_attrs_by_class = jitted_attr_names(module.tree)
+    findings: List[Finding] = []
+    seen: Set[tuple] = set()
+
+    def emit(node: ast.AST, msg: str) -> None:
+        key = (node.lineno, msg)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding(RULE, module.key, node.lineno,
+                                    node.col_offset, msg))
+
+    for qualname, fn in hot.items():
+        cls = qualname.split(".")[0] if "." in qualname else None
+        jitted_attrs = jit_attrs_by_class.get(cls, set()) if cls else set()
+        # nested defs that are THEMSELVES jitted run traced — a float()
+        # there is a TracerError, not a host sync; skip their bodies
+        jitted_nested = {id(f) for f, _ in
+                         jitted_local_defs(fn).values()}
+        arrayish = _arrayish_names(fn, jitted_attrs)
+        skip_ids: Set[int] = set()
+        for node in ast.walk(fn):
+            if id(node) in jitted_nested:
+                skip_ids.update(id(sub) for sub in ast.walk(node))
+        for node in ast.walk(fn):
+            if id(node) in skip_ids or not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "item" and not node.args:
+                    emit(node, f"'.item()' in hot path "
+                               f"({qualname}): blocking device->host "
+                               "sync per call")
+                    continue
+                if attr == "block_until_ready":
+                    emit(node, f"'.block_until_ready()' in hot path "
+                               f"({qualname}): stalls async dispatch")
+                    continue
+            if name in ("jax.device_get", "jax.block_until_ready"):
+                emit(node, f"'{name}' in hot path ({qualname}): "
+                           "blocking device->host transfer")
+                continue
+            if name and "." in name:
+                mod, leaf = name.rsplit(".", 1)
+                if mod in np_aliases and leaf in ("asarray", "array"):
+                    emit(node, f"'{name}' in hot path ({qualname}): "
+                               "materializes the device value on host")
+                    continue
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and node.args \
+                    and _mentions_arrayish(node.args[0], arrayish,
+                                           jitted_attrs):
+                emit(node, f"'{node.func.id}(...)' on a device value in "
+                           f"hot path ({qualname}): implicit host sync")
+    return findings

@@ -1,0 +1,209 @@
+"""Rule ``retrace``: recompilation hazards at jit/shard_map boundaries.
+
+The serve engine promises three compiled programs for its whole
+lifecycle; the trainer promises one train-step compile and zero
+retraces after warmup.  A retrace hazard is any pattern that makes XLA
+compile again on a later call with the same shapes:
+
+- **jit-in-loop / jit-in-hot-path**: constructing ``jax.jit(...)`` /
+  ``shard_map(...)`` inside a ``for``/``while`` body or inside a
+  hot-path function builds a FRESH callable (fresh cache) per
+  iteration/call — every invocation retraces.  Memoized constructions
+  (the serve engine's per-bucket prefill dict) carry a pragma.
+- **jit-used-immediately**: ``jax.jit(f)(x)`` or ``jax.jit(f).lower``
+  — the jitted callable is dropped after one use, so its cache is too.
+- **branch-on-traced**: a Python ``if``/``while`` on a non-static
+  parameter of a jitted function.  Under trace this either raises a
+  ``TracerBoolConversionError`` or — with static values smuggled in —
+  silently forks one compile per branch taken.
+- **unhashable-static**: calling a jitted function with a list/dict/set
+  literal in a position declared ``static_argnums``/``static_argnames``
+  — unhashable statics fail or, tupled per call site, retrace per call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..lint import (Finding, LintContext, ModuleInfo, dotted, is_jit_call,
+                    jitted_local_defs, reachable_functions)
+
+RULE = "retrace"
+
+
+# attribute reads of a tracer that are STATIC python values: branching
+# on them is legitimate (shapes/dtypes are fixed per compiled program)
+_STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size", "aval",
+                           "sharding", "weak_type"))
+
+
+def _static_uses(test: ast.AST) -> Set[int]:
+    """ids of Name nodes inside ``test`` whose use is static under
+    trace: ``x.shape``-style attribute reads, ``x is None`` identity
+    checks, and ``isinstance(x, ...)``."""
+    out: Set[int] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _STATIC_ATTRS \
+                and isinstance(node.value, ast.Name):
+            out.add(id(node.value))
+        elif isinstance(node, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops):
+            for sub in [node.left] + node.comparators:
+                if isinstance(sub, ast.Name):
+                    out.add(id(sub))
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("isinstance", "len", "type"):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out.add(id(sub))
+    return out
+
+
+def _loop_bodies(tree: ast.AST) -> Set[int]:
+    """ids of every node nested under a for/while body."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            for child in node.body + node.orelse:
+                out.update(id(sub) for sub in ast.walk(child))
+    return out
+
+
+def _hot_function_ids(module: ModuleInfo, ctx: LintContext) -> Dict[int, str]:
+    for suffix, qualnames in ctx.config.hot_roots.items():
+        if module.key == suffix or module.key.endswith("/" + suffix):
+            return {id(fn): qn for qn, fn in
+                    reachable_functions(module, qualnames).items()}
+    return {}
+
+
+def check(module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str) -> None:
+        findings.append(Finding(RULE, module.key, node.lineno,
+                                node.col_offset, msg))
+
+    in_loop = _loop_bodies(module.tree)
+    hot_fns = _hot_function_ids(module, ctx)
+
+    # ---- jit-in-loop / jit-in-hot-path / jit-used-immediately -------- #
+    containing_hot: Dict[int, str] = {}
+    for node in ast.walk(module.tree):
+        if id(node) in hot_fns:
+            for sub in ast.walk(node):
+                containing_hot.setdefault(id(sub), hot_fns[id(node)])
+    for node in ast.walk(module.tree):
+        if not is_jit_call(node):
+            continue
+        leaf = dotted(node.func).split(".")[-1]
+        if id(node) in in_loop:
+            emit(node, f"'{leaf}(...)' constructed inside a loop body: "
+                       "a fresh compilation cache per iteration — hoist "
+                       "the jitted callable out of the loop")
+        elif id(node) in containing_hot:
+            emit(node, f"'{leaf}(...)' constructed in hot path "
+                       f"({containing_hot[id(node)]}): a fresh callable "
+                       "per call retraces every time — construct once "
+                       "and reuse (or memoize)")
+    def _is_jit_only(call: ast.AST) -> bool:
+        # shard_map is a tracing transform (no compile cache of its own;
+        # idiomatically applied immediately inside an outer jit) — only
+        # jit/pjit results carry a cache worth keeping
+        if not is_jit_call(call):
+            return False
+        return dotted(call.func).split(".")[-1] in ("jit", "pjit")
+
+    for node in ast.walk(module.tree):
+        target = None
+        if isinstance(node, ast.Call) and _is_jit_only(node.func):
+            target = node.func  # jax.jit(f)(x)
+        elif isinstance(node, ast.Attribute) and _is_jit_only(node.value):
+            target = node.value  # jax.jit(f).lower(...)
+        if target is not None:
+            emit(node, "jit result used immediately and dropped: its "
+                       "compile cache dies with it — bind the jitted "
+                       "callable and reuse it")
+
+    # ---- branch-on-traced + unhashable-static ------------------------ #
+    scopes: List[ast.AST] = [module.tree]
+    scopes += [n for n in ast.walk(module.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))]
+    jitted: Dict[str, Tuple[ast.AST, Set[str]]] = {}
+    for scope in scopes:
+        jitted.update(jitted_local_defs(scope))
+    seen_fn_ids: Set[int] = set()
+    for name, (fn, static) in jitted.items():
+        if id(fn) in seen_fn_ids:
+            continue
+        seen_fn_ids.add(id(fn))
+        params = {a.arg for a in fn.args.args} - static - {"self"}
+        nested = {id(s) for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fn
+                  for s in ast.walk(n)}
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue  # inner defs get their own jit analysis if jitted
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            traced = [s.id for s in ast.walk(node.test)
+                      if isinstance(s, ast.Name) and s.id in params
+                      and id(s) not in _static_uses(node.test)]
+            if traced:
+                kind = "while" if isinstance(node, ast.While) else "if"
+                emit(node, f"Python '{kind}' on traced value(s) "
+                           f"{sorted(set(traced))} in jitted '{name}': "
+                           "branching under trace fails or forks one "
+                           "compile per branch — use lax.cond/lax.select "
+                           "(or declare the arg static)")
+
+    # calling a jitted name with an unhashable literal in a static slot
+    static_by_name: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for scope in scopes:
+        for node in getattr(scope, "body", []):
+            if isinstance(node, ast.Assign) and is_jit_call(node.value) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                nums: Set[int] = set()
+                names: Set[str] = set()
+                for kw in node.value.keywords:
+                    v = kw.value
+                    elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                        else [v]
+                    if kw.arg == "static_argnums":
+                        nums |= {e.value for e in elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int)}
+                    elif kw.arg == "static_argnames":
+                        names |= {e.value for e in elts
+                                  if isinstance(e, ast.Constant)
+                                  and isinstance(e.value, str)}
+                if nums or names:
+                    static_by_name[node.targets[0].id] = (nums, names)
+    if static_by_name:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_by_name):
+                continue
+            nums, names = static_by_name[node.func.id]
+            bad = (ast.List, ast.Dict, ast.Set)
+            for i, arg in enumerate(node.args):
+                if i in nums and isinstance(arg, bad):
+                    emit(arg, f"unhashable {type(arg).__name__.lower()} "
+                              f"literal passed as static arg {i} of "
+                              f"jitted '{node.func.id}': unhashable "
+                              "statics fail (or retrace per call) — pass "
+                              "a tuple/frozen value")
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, bad):
+                    emit(kw.value, f"unhashable literal passed as static "
+                                   f"arg '{kw.arg}' of jitted "
+                                   f"'{node.func.id}' — pass a "
+                                   "tuple/frozen value")
+    return findings

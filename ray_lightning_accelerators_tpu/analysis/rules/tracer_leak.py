@@ -1,0 +1,73 @@
+"""Rule ``tracer-leak``: traced values escaping a jitted function.
+
+Assigning to ``self.x``, a global, or any object that outlives the
+trace from inside a jitted function stores a *tracer*, not an array.
+The stored value is garbage after tracing finishes (jax raises
+``UnexpectedTracerError`` at best, silently holds a leaked trace at
+worst), and the side effect re-runs only on RETRACE — so the code
+appears to work exactly until the compile cache warms up, the classic
+heisenbug this rule exists to keep out of the tree.
+
+Scope: functions that are jitted (decorated ``@jax.jit`` /
+``@partial(jax.jit, ...)`` or passed by name to ``jax.jit``/``pjit``/
+``shard_map`` in the same scope).  Flagged inside them:
+
+- assignment (or aug-assignment) to an attribute rooted at ``self``
+- ``global``/``nonlocal`` declarations (smuggling values out of the
+  trace through an outer scope)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from ..lint import Finding, LintContext, ModuleInfo, jitted_local_defs
+
+RULE = "tracer-leak"
+
+
+def _root_is_self(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def check(module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    scopes: List[ast.AST] = [module.tree]
+    scopes += [n for n in ast.walk(module.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))]
+    jitted: List[Tuple[str, ast.AST]] = []
+    seen: Set[int] = set()
+    for scope in scopes:
+        for name, (fn, _static) in jitted_local_defs(scope).items():
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                jitted.append((name, fn))
+
+    for name, fn in jitted:
+        for node in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                        and _root_is_self(tgt):
+                    findings.append(Finding(
+                        RULE, module.key, node.lineno, node.col_offset,
+                        f"assignment to '{ast.unparse(tgt)}' inside "
+                        f"jitted '{name}': stores a tracer that outlives "
+                        "the trace (and the write replays only on "
+                        "retrace) — return the value instead"))
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+                findings.append(Finding(
+                    RULE, module.key, node.lineno, node.col_offset,
+                    f"'{kw} {', '.join(node.names)}' inside jitted "
+                    f"'{name}': values smuggled out of a trace are "
+                    "tracers — return them instead"))
+    return findings

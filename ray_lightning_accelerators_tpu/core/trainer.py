@@ -38,6 +38,7 @@ import optax
 
 from ..accelerators.base import Accelerator
 from ..accelerators.tpu import RayTPUAccelerator
+from ..analysis import knobs
 from ..data import prefetch as prefetch_lib
 from ..data.loader import DataLoader
 from ..parallel import mesh as mesh_lib
@@ -314,13 +315,8 @@ class Trainer:
         # a per-step allgather would serialize the async dispatch
         # pipeline for the run's whole lifetime.  Single process pays
         # nothing and checks every step.
-        raw = os.environ.get(preempt_lib.PREEMPT_CONSENSUS_EVERY_ENV, "")
-        try:
-            self._preempt_check_every = max(1, int(raw)) if raw else 8
-        except ValueError:
-            log.warning("bad %s=%r; using 8",
-                        preempt_lib.PREEMPT_CONSENSUS_EVERY_ENV, raw)
-            self._preempt_check_every = 8
+        self._preempt_check_every = max(1, knobs.get_int(
+            preempt_lib.PREEMPT_CONSENSUS_EVERY_ENV, 8))
         self._preempt_notice = notice
 
     def _maybe_drain_preemption(self, every_step: bool = False) -> None:
@@ -930,7 +926,8 @@ class Trainer:
             hits = [i for i in range(nb)
                     if (first_step + i + 1) % cadence == 0]
             if hits:
-                host = jax.device_get(stacked)
+                # graftlint: ok(host-sync) — one post-epoch readback of
+                host = jax.device_get(stacked)  # the stacked metrics
                 for i in hits:
                     self._log_now({k: float(v[i])
                                    for k, v in host.items()},
@@ -1018,6 +1015,7 @@ class Trainer:
         if jax.process_count() > 1:
             return jax.tree.map(
                 lambda x: jax.make_array_from_process_local_data(
+                    # graftlint: ok(host-sync) — host->device placement
                     self._batch_sharding, np.asarray(x)), batch)
         return jax.device_put(batch, self._batch_sharding)
 
@@ -1036,7 +1034,7 @@ class Trainer:
     # jax.distributed world formed before fit runs in each process.
 
     def _launch_plan(self) -> Optional[Dict[str, Any]]:
-        if os.environ.get("RLA_TPU_INSIDE_WORKER") == "1":
+        if knobs.get_bool("RLA_TPU_INSIDE_WORKER"):
             return None  # already a fanned-out worker process
         if jax.process_count() > 1:
             return None  # already inside a formed distributed world
@@ -1050,7 +1048,7 @@ class Trainer:
         jax.config."""
         env = {"RLA_TPU_INSIDE_WORKER": "1"}
         platform = cpu_per = None
-        worker_platform = os.environ.get("RLA_TPU_WORKER_PLATFORM")
+        worker_platform = knobs.get_raw("RLA_TPU_WORKER_PLATFORM")
         if worker_platform:
             # explicit split: workers claim this platform while the
             # driver keeps its own backend -- the single-chip layout,
@@ -1377,38 +1375,8 @@ class Trainer:
                     if (self.limit_train_batches is not None
                             and batch_idx >= self.limit_train_batches):
                         break
-                    if kind == "cached_local":
-                        # synchronous path (prefetch off): the pipeline's
-                        # _place_train_item does this conversion otherwise
-                        with self._span("h2d"):
-                            kind, payload = ("cached",
-                                             self._put_index_row(payload))
-                    if kind == "cached":
-                        with self._span("train_step") as h:
-                            state, train_metrics = \
-                                self._train_step_cached_fn(
-                                    state, self._device_cache, payload)
-                            if h is not None:
-                                h.set(train_metrics)
-                    else:
-                        if pf is None:
-                            with self._span("h2d"):
-                                batch = self._put_batch(payload)
-                        else:
-                            batch = payload  # placed by the pipeline
-                        with self._span("train_step") as h:
-                            state, train_metrics = self._train_step_fn(
-                                state, batch)
-                            if h is not None:
-                                h.set(train_metrics)
-                    self.global_step += 1
-                    self._state = state
-                    for c in self.callbacks:
-                        c.on_train_batch_end(self, module, train_metrics,
-                                             batch_idx)
-                    if self.global_step % self.log_every_n_steps == 0:
-                        self._log_now({f"{k}": float(v) for k, v in
-                                       jax.device_get(train_metrics).items()})
+                    state, train_metrics = self._fit_step(
+                        state, kind, payload, pf, module, batch_idx)
                     if (self.val_check_interval
                             and self._val_loader is not None
                             and self.global_step % self.val_check_interval
@@ -1457,6 +1425,49 @@ class Trainer:
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
         self.fit_duration_s = time.perf_counter() - t0
+
+    def _fit_step(self, state, kind, payload, pf, module,
+                  batch_idx: int):
+        """ONE optimizer step of the fit loop: place the batch, run the
+        compiled step, fire per-batch callbacks, log on the cadence.
+
+        This is the hot path graftlint's ``host-sync`` rule roots at
+        (with ``_run_scanned_epoch``): everything here dispatches async
+        — the only device->host materialization is the log-interval-
+        gated metrics readback below, and the compile-guard test pins
+        the whole loop to zero retraces after warmup."""
+        if kind == "cached_local":
+            # synchronous path (prefetch off): the pipeline's
+            # _place_train_item does this conversion otherwise
+            with self._span("h2d"):
+                kind, payload = ("cached", self._put_index_row(payload))
+        if kind == "cached":
+            with self._span("train_step") as h:
+                state, train_metrics = self._train_step_cached_fn(
+                    state, self._device_cache, payload)
+                if h is not None:
+                    h.set(train_metrics)
+        else:
+            if pf is None:
+                with self._span("h2d"):
+                    batch = self._put_batch(payload)
+            else:
+                batch = payload  # placed by the pipeline
+            with self._span("train_step") as h:
+                state, train_metrics = self._train_step_fn(
+                    state, batch)
+                if h is not None:
+                    h.set(train_metrics)
+        self.global_step += 1
+        self._state = state
+        for c in self.callbacks:
+            c.on_train_batch_end(self, module, train_metrics,
+                                 batch_idx)
+        if self.global_step % self.log_every_n_steps == 0:
+            # graftlint: ok(host-sync) — log-interval-gated readback
+            self._log_now({f"{k}": float(v) for k, v in
+                           jax.device_get(train_metrics).items()})  # graftlint: ok(host-sync) — gated above
+        return state, train_metrics
 
     def _after_train_epoch(self, module, train_metrics) -> None:
         """Epoch epilogue shared by the step loop and the scanned path:

@@ -18,13 +18,13 @@ exists to exercise and benchmark the framework's TPU path end-to-end:
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from ..analysis import knobs
 from ..core.module import TpuModule
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
@@ -591,7 +591,7 @@ class GPT(TpuModule):
             # (mirrors the _embed_lookup t_size gate above)
             return None
         if forced is None and jax.default_backend() in ("tpu", "axon") \
-                and not os.environ.get("RLA_TPU_DISABLE_Q8_KERNEL"):
+                and not knobs.get_flag("RLA_TPU_DISABLE_Q8_KERNEL"):
             return "compiled"
         return None
 

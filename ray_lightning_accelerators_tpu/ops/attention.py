@@ -23,13 +23,14 @@ a reference jnp implementation with identical semantics.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..analysis import knobs
 
 _NEG_INF = -1e30
 
@@ -397,7 +398,7 @@ def _pick_block(requested: int, length: int) -> Optional[int]:
 
 def _use_pallas(q: jax.Array, block_q: Optional[int],
                 block_k: Optional[int]) -> bool:
-    if os.environ.get("RLA_TPU_DISABLE_PALLAS"):
+    if knobs.get_flag("RLA_TPU_DISABLE_PALLAS"):
         return False
     if jax.default_backend() not in ("tpu", "axon"):
         return False
@@ -410,15 +411,11 @@ def _use_pallas(q: jax.Array, block_q: Optional[int],
 def _default_blocks() -> tuple:
     """Kernel block sizes: (block_q, block_k), overridable via
     RLA_TPU_FLASH_BLOCK_Q/K for shape-specific tuning (read at trace
-    time, so set before the first jit of a given shape)."""
-    def read(var: str) -> int:
-        raw = os.environ.get(var, "")
-        try:
-            return int(raw) if raw else 512
-        except ValueError as e:
-            # fail HERE with the variable named, not deep inside a trace
-            raise ValueError(f"{var}={raw!r} is not an integer") from e
-    return read("RLA_TPU_FLASH_BLOCK_Q"), read("RLA_TPU_FLASH_BLOCK_K")
+    time, so set before the first jit of a given shape).  A malformed
+    value warns (naming the variable) and keeps the default — the knobs
+    contract: a typo'd tuning knob must not kill a training run."""
+    return (knobs.get_int("RLA_TPU_FLASH_BLOCK_Q", 512),
+            knobs.get_int("RLA_TPU_FLASH_BLOCK_K", 512))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

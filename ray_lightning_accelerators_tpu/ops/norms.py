@@ -14,12 +14,13 @@ lane-aligned, jnp reference elsewhere (and as the recompute backward via
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ..analysis import knobs
 
 
 # --------------------------------------------------------------------- #
@@ -86,7 +87,7 @@ def _norm_call(kernel, x2: jax.Array, params, eps: float, interpret: bool):
 
 
 def _use_pallas(d: int) -> bool:
-    if os.environ.get("RLA_TPU_DISABLE_PALLAS"):
+    if knobs.get_flag("RLA_TPU_DISABLE_PALLAS"):
         return False
     if jax.default_backend() not in ("tpu", "axon"):
         return False

@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import cloudpickle
 
+from ..analysis import knobs
 from ..utils.logging import log
 from .watchdog import (HeartbeatChannel, WorkerBeat, WorkerWedged,
                        heartbeat_interval_s)
@@ -82,7 +83,7 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
     # A broken spec surfaces on the first dispatch's future, not by
     # killing the worker silently.
     chaos = chaos_error = None
-    if os.environ.get("RLA_TPU_CHAOS"):
+    if knobs.get_raw("RLA_TPU_CHAOS"):
         try:
             from ..testing.chaos import ChaosInjector
             chaos = ChaosInjector.from_env(
@@ -160,8 +161,7 @@ class Worker:
         # write a bogus preemption flag into the shared run dir
         from .preemption import PREEMPT_GRACE_ENV
         self._sigterm_is_notice = bool(
-            self._env.get(PREEMPT_GRACE_ENV)
-            or os.environ.get(PREEMPT_GRACE_ENV))
+            knobs.get_raw(PREEMPT_GRACE_ENV, env=self._env))
         # liveness channel interval: explicit arg > per-worker env >
         # process env > default; <= 0 disables the channel entirely
         self._heartbeat_s = (heartbeat_s if heartbeat_s is not None
@@ -271,6 +271,8 @@ class Worker:
         return fut
 
     def _collect(self, conn, proc, pending_list, meta=None) -> None:
+        from .wire import rebuild_remote
+
         while True:
             try:
                 blob = conn.recv_bytes()
@@ -301,8 +303,12 @@ class Worker:
                 elif status == "ok":
                     fut.set_result(cloudpickle.loads(payload))
                 else:
+                    # same typed-rebuild registry as the agent relay
+                    # (runtime/wire.py): a Preempted/WorkerWedged raised
+                    # INSIDE dispatched work crosses the local pipe as
+                    # typed as it crosses the relay
                     name, msg, tb = cloudpickle.loads(payload)
-                    fut.set_exception(RemoteError(name, msg, tb))
+                    fut.set_exception(rebuild_remote(name, msg, tb))
             except BaseException as e:
                 # a result that can't unpickle driver-side (e.g. a class only
                 # importable in the worker) must fail ITS future, not kill

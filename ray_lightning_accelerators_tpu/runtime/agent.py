@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ..analysis import knobs
 from ..utils.logging import log
 
 _LEN = struct.Struct(">I")
@@ -53,8 +54,7 @@ AUTH_MAGIC = b"RLA-TPU-AUTH1:"
 
 
 def _token_from_env() -> Optional[str]:
-    tok = os.environ.get(TOKEN_ENV, "")
-    return tok or None
+    return knobs.get_str(TOKEN_ENV, None)
 
 
 def check_auth_frame(raw: bytes, token: Optional[str]) -> Optional[bool]:
@@ -301,8 +301,7 @@ class AgentConnection:
         if timeout is None:
             # how long to keep retrying an unreachable agent (boot grace);
             # tests / fail-fast deployments shrink it via env
-            timeout = float(os.environ.get("RLA_TPU_AGENT_CONNECT_TIMEOUT",
-                                           30.0))
+            timeout = knobs.get_float("RLA_TPU_AGENT_CONNECT_TIMEOUT", 30.0)
         token = token if token is not None else _token_from_env()
         host, port = parse_address(address)
         # retry while the agent boots: "start agents, then the driver" is
@@ -360,8 +359,7 @@ class AgentConnection:
         return self.request(op, payload).result(timeout=timeout)
 
     def _recv_loop(self) -> None:
-        from .actors import RemoteError
-        from .watchdog import WorkerWedged
+        from .wire import rebuild_remote
 
         while True:
             try:
@@ -390,22 +388,12 @@ class AgentConnection:
                 elif status == "raw-ok":
                     fut.set_result(cloudpickle.loads(payload))
                 else:
+                    # rebuild typed outcomes (WorkerWedged diagnosis,
+                    # Preempted step/checkpoint info, resize refusals)
+                    # from the wire registry so driver-side retry layers
+                    # classify them; everything else stays RemoteError
                     name, msg, tb = cloudpickle.loads(payload)
-                    if name == "WorkerWedged":
-                        # an agent-side watchdog reap crossed the relay as
-                        # (name, str, tb); rebuild the typed wedge (with
-                        # its embedded diagnosis) so driver-side retry
-                        # layers classify it correctly
-                        fut.set_exception(WorkerWedged.from_message(msg))
-                    elif name == "Preempted":
-                        # same treatment for a graceful preemption drain:
-                        # the embedded step/checkpoint info must survive
-                        # the relay so the driver resumes instead of
-                        # charging a failure (runtime/preemption.py)
-                        from .preemption import Preempted
-                        fut.set_exception(Preempted.from_message(msg))
-                    else:
-                        fut.set_exception(RemoteError(name, msg, tb))
+                    fut.set_exception(rebuild_remote(name, msg, tb))
             except BaseException as e:
                 fut.set_exception(RuntimeError(
                     f"failed to deserialize result from agent "
@@ -522,7 +510,7 @@ def _set_env_remote(key: str, value: str) -> None:
 def agents_from_env() -> Optional[List[str]]:
     """Agent addresses from ``RLA_TPU_AGENTS`` (comma-separated), set by
     ``rla-tpu launch`` or the user."""
-    raw = os.environ.get("RLA_TPU_AGENTS", "").strip()
+    raw = (knobs.get_str("RLA_TPU_AGENTS", "") or "").strip()
     return [a.strip() for a in raw.split(",") if a.strip()] or None
 
 
@@ -566,7 +554,7 @@ def check_tokenless_wide_bind(what: str, bind: str,
     and even then the exposure is logged on every start."""
     if token is not None or is_loopback(bind):
         return
-    if os.environ.get("RLA_TPU_ALLOW_TOKENLESS_BIND") != "1":
+    if not knobs.get_bool("RLA_TPU_ALLOW_TOKENLESS_BIND"):
         raise RuntimeError(
             f"{what} refuses to bind {bind} without {TOKEN_ENV}: any "
             "host that can reach this port can execute code as this "

@@ -39,11 +39,11 @@ Trainer.fit(ckpt_path="last")).
 from __future__ import annotations
 
 import inspect
-import os
 import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..analysis import knobs
 from ..utils.logging import log
 from . import preemption as preempt_lib
 from .actors import ActorPool
@@ -73,17 +73,6 @@ def backoff_delay_s(attempt: int, base_s: float,
         return 0.0
     d = min(cap_s, base_s * (2.0 ** (attempt - 1)))
     return d * (0.5 + 0.5 * rng())
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        log.warning("bad %s=%r; using %s", name, raw, default)
-        return default
 
 
 class ElasticRunner:
@@ -129,8 +118,9 @@ class ElasticRunner:
         retryably with ``WorkerWedged`` instead of hanging forever."""
         self.pool = pool
         self.max_failures = max_failures
-        self.backoff_s = _env_float(BACKOFF_BASE_ENV, backoff_s)
-        self.backoff_cap_s = _env_float(BACKOFF_CAP_ENV, backoff_cap_s)
+        self.backoff_s = knobs.get_float(BACKOFF_BASE_ENV, backoff_s)
+        self.backoff_cap_s = knobs.get_float(BACKOFF_CAP_ENV,
+                                             backoff_cap_s)
         self.on_failure = on_failure
         self.init_hook = init_hook
         self.wedge_timeout_s = wedge_timeout_s

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..analysis import knobs
 from ..utils.logging import log
 
 PREEMPT_GRACE_ENV = "RLA_TPU_PREEMPT_GRACE_S"
@@ -58,16 +59,11 @@ FLAG_FILENAME = ".rla_preempt_notice"
 def grace_from_env() -> Optional[float]:
     """The configured grace budget, or None when preemption handling is
     not enabled (the handler stays uninstalled; SIGTERM keeps its default
-    kill semantics so pool teardown is never slowed down)."""
-    raw = os.environ.get(PREEMPT_GRACE_ENV, "")
-    if not raw:
-        return None
-    try:
-        return float(raw)
-    except ValueError:
-        log.warning("bad %s=%r; using %.1fs", PREEMPT_GRACE_ENV, raw,
-                    DEFAULT_GRACE_S)
-        return DEFAULT_GRACE_S
+    kill semantics so pool teardown is never slowed down).  A malformed
+    value still ENABLES handling (the operator clearly asked for it) at
+    the default budget."""
+    return knobs.get_float(PREEMPT_GRACE_ENV, None,
+                           malformed=DEFAULT_GRACE_S)
 
 
 class Preempted(RuntimeError):

@@ -41,11 +41,11 @@ from __future__ import annotations
 
 import json
 import multiprocessing as mp
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis import knobs
 from ..utils.logging import log
 
 HEARTBEAT_ENV = "RLA_TPU_WORKER_HEARTBEAT_S"
@@ -62,30 +62,13 @@ STATE_DEAD = "dead"
 def heartbeat_interval_s(env: Optional[Dict[str, str]] = None) -> float:
     """Beat interval; a per-worker env overrides the process env.
     ``<= 0`` disables the channel entirely (liveness-only supervision)."""
-    raw = None
-    if env:
-        raw = env.get(HEARTBEAT_ENV)
-    if raw is None:
-        raw = os.environ.get(HEARTBEAT_ENV)
-    try:
-        return float(raw) if raw not in (None, "") else DEFAULT_HEARTBEAT_S
-    except ValueError:
-        log.warning("bad %s=%r; using %.1fs", HEARTBEAT_ENV, raw,
-                    DEFAULT_HEARTBEAT_S)
-        return DEFAULT_HEARTBEAT_S
+    return knobs.get_float(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_S, env=env)
 
 
 def wedge_timeout_from_env() -> Optional[float]:
     """The env-configured wedge threshold, or None when unset (supervision
     stays opt-in for entry points that only watch when configured)."""
-    raw = os.environ.get(WEDGE_ENV, "")
-    if not raw:
-        return None
-    try:
-        return float(raw)
-    except ValueError:
-        log.warning("bad %s=%r; ignoring", WEDGE_ENV, raw)
-        return None
+    return knobs.get_float(WEDGE_ENV, None)
 
 
 class WorkerWedged(RuntimeError):

@@ -1,0 +1,80 @@
+"""Wire-exception registry: typed errors that survive the worker pipe.
+
+A worker-side exception crosses the actor pipe (and the agent relay) as
+``(type name, message, traceback)`` — see ``actors._worker_main``.  The
+retry layers only work when the SEMANTIC types are rebuilt driver-side:
+``Preempted`` must resume without charging the failure budget,
+``WorkerWedged`` must read as a retryable hang, ``ElasticResizeError``
+must read as "pick a compatible size", never as a generic crash.
+
+This module is the single reconstruction point.  ``WIRE_EXCEPTION_NAMES``
+is the declared set (a literal, so graftlint's ``wire-exception`` rule
+can extract it statically and reject raises of unregistered typed
+exceptions in worker-dispatched code); ``rebuild_remote`` is the runtime
+half used by both the local collector (``actors.Worker._collect``) and
+the agent relay (``agent._recv_loop``) — before this registry the two
+paths drifted (the relay rebuilt typed wedges, the local pipe wrapped
+everything in ``RemoteError``).
+
+Classes carrying structured payloads embed them in the message
+(``WorkerWedged._MARKER`` / ``Preempted._MARKER``) and rebuild via
+``from_message``; plain typed outcomes rebuild from the message alone.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+# the declared registry: keep this a LITERAL set of class names — the
+# static analyzer reads it without importing the runtime
+WIRE_EXCEPTION_NAMES = frozenset({
+    "WorkerWedged",
+    "Preempted",
+    "ElasticResizeError",
+    "QueueShutdown",
+    "ObjectStoreError",
+})
+
+
+def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
+    # imported lazily: wire.py must stay importable from any runtime
+    # module without creating cycles
+    from .elastic import ElasticResizeError
+    from .object_store import ObjectStoreError
+    from .preemption import Preempted
+    from .queue import QueueShutdown
+    from .watchdog import WorkerWedged
+
+    return {
+        "WorkerWedged": WorkerWedged.from_message,
+        "Preempted": Preempted.from_message,
+        "ElasticResizeError": ElasticResizeError,
+        "QueueShutdown": QueueShutdown,
+        "ObjectStoreError": ObjectStoreError,
+    }
+
+
+def rebuild_remote(name: str, message: str,
+                   remote_traceback: str) -> BaseException:
+    """The typed exception for a wire tuple, or ``RemoteError`` for
+    anything unregistered (builtins and one-off errors stay generic on
+    purpose: only types a retry/orchestration layer branches on belong
+    in the registry).
+
+    Rebuilt exceptions carry ``remote_typed = True``: they came from an
+    ``(name, message, tb)`` error payload — i.e. the DISPATCHED CODE
+    raised them — as opposed to the same types constructed driver-side
+    by supervision (a watchdog ``WorkerWedged.for_rank``).  Failure
+    classifiers (``serve/replicas.py``) use the flag to keep worker-side
+    application errors from reading as infrastructure death."""
+    from .actors import RemoteError
+
+    rebuild = _rebuilders().get(name)
+    if rebuild is not None:
+        try:
+            exc = rebuild(message)
+            exc.remote_typed = True
+            return exc
+        except Exception:  # a malformed payload must not mask the error
+            pass
+    return RemoteError(name, message, remote_traceback)

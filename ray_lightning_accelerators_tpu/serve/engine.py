@@ -118,9 +118,18 @@ class ServeEngine:
         donate = jax.default_backend() != "cpu"
         self._join = jax.jit(type(model).cache_join,
                              donate_argnums=(0,) if donate else ())
-        self._step = jax.jit(
-            lambda p, c, t, pos: model.decode_step_rows(p, c, t, pos),
-            donate_argnums=(1,) if donate else ())
+
+        def step_tokens(p, c, t, pos):
+            # argmax INSIDE the compiled step: the engine's lifecycle
+            # stays exactly three programs (compile-guard asserts it),
+            # and the per-step device->host transfer is [B] tokens
+            # instead of [B, vocab] logits
+            logits, cache = model.decode_step_rows(p, c, t, pos)
+            return jax.numpy.argmax(logits, -1).astype(jax.numpy.int32), \
+                cache
+
+        self._step = jax.jit(step_tokens,
+                             donate_argnums=(1,) if donate else ())
         self._prefills: Dict[int, Any] = {}
         self._cache = None
         self._slots: List[Optional[_Slot]] = [None] * max_slots
@@ -231,7 +240,10 @@ class ServeEngine:
                                                model.compute_dtype)
                 return jnp.argmax(logits, -1).astype(jnp.int32), cache
 
-            self._prefills[padded_len] = jax.jit(fn)
+            # memoized per prompt bucket: each padded length compiles
+            # exactly once for the engine's lifetime, bounded by
+            # max_total_len / prompt_block buckets
+            self._prefills[padded_len] = jax.jit(fn)  # graftlint: ok(retrace) — memoized per bucket
         return self._prefills[padded_len]
 
     def _admit(self) -> int:
@@ -259,7 +271,8 @@ class ServeEngine:
                 # row would copy the whole multi-slot cache for nothing
                 self._cache = self._join(self._cache, row_cache,
                                          jnp.int32(i))
-            first = int(np.asarray(tok0)[0])  # host sync: token is real now
+            # graftlint: ok(host-sync) — TTFT gate: the first token must
+            first = int(np.asarray(tok0)[0])  # be real before it is timed
             now = time.monotonic()
             resp.ttft_s = now - req.t_submit
             self.metrics.observe_ttft(resp.ttft_s)
@@ -285,10 +298,12 @@ class ServeEngine:
             toks[i] = s.last
             poss[i] = s.pos
         t0 = time.monotonic()
-        logits, self._cache = self._step(self.params, self._cache,
-                                         jnp.asarray(toks),
-                                         jnp.asarray(poss))
-        nxt = np.asarray(jnp.argmax(logits, -1))  # host sync gates the feed
+        toks_next, self._cache = self._step(self.params, self._cache,
+                                            jnp.asarray(toks),
+                                            jnp.asarray(poss))
+        # deliberate: step k+1's input IS step k's output, so the loop
+        # must materialize it — the one sync a greedy feed cannot avoid
+        nxt = np.asarray(toks_next)  # graftlint: ok(host-sync) — feed gate
         now = time.monotonic()
         self.metrics.observe_step(now - t0, len(active))
         for i in active:
@@ -306,8 +321,8 @@ class ServeEngine:
 
     def _finish(self, req: ServeRequest, resp: ServeResponse,
                 generated: List[int]) -> None:
-        tokens = np.concatenate(
-            [req.prompt, np.asarray(generated, np.int32)])
+        tokens = np.concatenate(  # graftlint: ok(host-sync) — host list,
+            [req.prompt, np.asarray(generated, np.int32)])  # no device value
         if resp._complete(tokens):
             self.metrics.inc("completed")
 

@@ -69,6 +69,24 @@ def _replica_serve(items: List[Tuple[int, Any, int]]) -> List[
     return [(rid, np.asarray(h.result())) for rid, h in handles]
 
 
+def _is_application_failure(exc: BaseException) -> bool:
+    """Failure triage for a chunk dispatch: True when the DISPATCHED
+    CODE failed deterministically (fail those requests, keep the replica
+    serving), False for infrastructure death (mark the replica down,
+    requeue onto survivors).
+
+    Application = a ``RemoteError`` payload, or a typed exception
+    ``runtime/wire.py`` rebuilt from a worker-raised payload
+    (``remote_typed`` — e.g. an ``ObjectStoreError`` from a stale ref:
+    deterministic per request, and requeueing it would cascade a
+    poisoned request through every replica).  A ``WorkerWedged`` stays
+    infrastructure even when the worker itself raised it."""
+    if isinstance(exc, RemoteError):
+        return True
+    return (getattr(exc, "remote_typed", False)
+            and not isinstance(exc, WorkerWedged))
+
+
 def _replica_stats() -> Dict[str, Any]:
     """Engine metrics snapshot (runs IN the worker)."""
     if _ENGINE is None:
@@ -260,7 +278,7 @@ class ServeReplicas:
                 elif resp._complete(tokens):
                     self.metrics.inc("completed")
             return
-        if isinstance(exc, RemoteError):
+        if _is_application_failure(exc):
             # application failure: deterministic, don't poison survivors
             log.error("replica %d failed a chunk application-side: %s",
                       rank, exc)

@@ -52,6 +52,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from ..analysis import knobs
+
 CHAOS_ENV = "RLA_TPU_CHAOS"
 CHAOS_NS_ENV = "RLA_TPU_CHAOS_NS"
 CHAOS_EXIT_CODE = 43
@@ -167,11 +169,11 @@ class ChaosInjector:
     def from_env(cls, rank: int,
                  freeze_heartbeat: Optional[Callable[[], None]] = None
                  ) -> Optional["ChaosInjector"]:
-        spec = os.environ.get(CHAOS_ENV, "")
+        spec = knobs.get_str(CHAOS_ENV, "")
         if not spec:
             return None
         return cls(parse_chaos(spec), rank, freeze_heartbeat,
-                   os.environ.get(CHAOS_NS_ENV) or None)
+                   knobs.get_raw(CHAOS_NS_ENV) or None)
 
     def _lost_marker(self, fault: ChaosFault) -> str:
         """Persistent 'host gone' marker path for a lost fault on THIS

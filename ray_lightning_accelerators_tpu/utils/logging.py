@@ -15,12 +15,20 @@ import logging
 import os
 from typing import Dict, List, Optional
 
+from ..analysis import knobs
+
 log = logging.getLogger("ray_lightning_accelerators_tpu")
 if not log.handlers:
     _h = logging.StreamHandler()
     _h.setFormatter(logging.Formatter("[%(levelname)s rla-tpu] %(message)s"))
     log.addHandler(_h)
-    log.setLevel(os.environ.get("RLA_TPU_LOG_LEVEL", "WARNING"))
+    _level = knobs.get_str("RLA_TPU_LOG_LEVEL", "WARNING").upper()
+    if not isinstance(logging.getLevelName(_level), int):
+        # a typo'd level must not crash at import time (setLevel raises)
+        log.setLevel("WARNING")
+        log.warning("bad RLA_TPU_LOG_LEVEL=%r; using WARNING", _level)
+    else:
+        log.setLevel(_level)
 
 
 class Logger:

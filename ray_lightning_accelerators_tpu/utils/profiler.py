@@ -121,7 +121,8 @@ class Profiler:
             with jax.profiler.TraceAnnotation(name):
                 yield handle
                 if self.sync and handle.value is not None:
-                    jax.block_until_ready(handle.value)
+                    # graftlint: ok(host-sync) — opt-in sync=True mode:
+                    jax.block_until_ready(handle.value)  # measure compute
         finally:
             dt = time.perf_counter() - t0
             stack.pop()
@@ -418,6 +419,7 @@ def flops_estimate(fn, *args, **kwargs) -> Optional[float]:
     estimate.  Trace-only: nothing executes on device."""
     import jax
 
+    # graftlint: ok(retrace) — trace-only cost estimate, once per bench
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
     try:
         analyses = compiled.cost_analysis()

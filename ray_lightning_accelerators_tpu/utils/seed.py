@@ -14,16 +14,26 @@ from typing import Optional
 import jax
 import numpy as np
 
+from ..analysis import knobs
+from .logging import log
+
 SEED_ENV_VARS = ("RLA_TPU_GLOBAL_SEED", "PL_GLOBAL_SEED")
 
 
 def seed_everything(seed: Optional[int] = None) -> int:
     """Seed python/numpy RNGs, export the seed for child processes."""
     if seed is None:
-        for var in SEED_ENV_VARS:
-            if os.environ.get(var):
-                seed = int(os.environ[var])
-                break
+        # our knob first (typed, warn-and-default on malformed), then
+        # the reference-parity PL name (non-RLA: raw read is sanctioned)
+        seed = knobs.get_int("RLA_TPU_GLOBAL_SEED", None, malformed=0)
+    if seed is None:
+        raw = os.environ.get("PL_GLOBAL_SEED")
+        if raw:
+            try:
+                seed = int(raw)
+            except ValueError:
+                log.warning("bad PL_GLOBAL_SEED=%r; using 0", raw)
+                seed = 0
         else:
             seed = 0
     random.seed(seed)

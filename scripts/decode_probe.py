@@ -140,12 +140,20 @@ def probe_scanned(m=16, d=768, layers=12, interpret=False) -> dict:
 
 
 def main() -> None:
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
+
+    cg.install()
     interpret = "--interpret" in sys.argv
     for fn in (lambda: probe_kernel(16, 768, 768, interpret),
                lambda: probe_kernel(16, 768, 50304, interpret),
                lambda: probe_scanned(interpret=interpret)):
         try:
-            print(json.dumps(fn()), flush=True)
+            c0 = cg.compile_count()
+            rec = fn()
+            print(json.dumps(rec), flush=True)
+            print(json.dumps(dict(
+                cg.compile_count_record("decode"),
+                probe_new_compiles=cg.compile_count() - c0)), flush=True)
         except Exception as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:400]}),
                   flush=True)

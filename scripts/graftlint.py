@@ -1,0 +1,58 @@
+"""graftlint CLI: lint the package tree, exit nonzero on findings.
+
+Usage::
+
+    python scripts/graftlint.py [PATH ...] [--verbose]
+
+Defaults to the ``ray_lightning_accelerators_tpu`` package next to this
+script.  ``--verbose`` also prints pragma-suppressed findings (the
+deliberate, documented violations).  Wired into ``format.sh`` and run
+as a tier-1 test (``pytest -m analysis``).
+
+Import note: only ``analysis.lint`` is loaded (stdlib-only AST work) —
+linting never initializes a jax backend, so this is safe on a machine
+whose accelerator is wedged.
+"""
+
+import importlib
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "ray_lightning_accelerators_tpu")
+
+
+def _load_lint():
+    """Load analysis.lint WITHOUT importing the package __init__ (which
+    pulls in jax): the analysis subpackage is a dependency leaf, so it
+    mounts cleanly as its own top-level package."""
+    pkg_dir = os.path.join(PACKAGE, "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_graftlint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_graftlint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return importlib.import_module("_graftlint_analysis.lint")
+
+
+def main(argv) -> int:
+    lint = _load_lint()
+
+    verbose = "--verbose" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        paths = [PACKAGE]
+    rc = 0
+    for path in paths:
+        findings = lint.lint_path(path)
+        text, code = lint.report(findings, verbose=verbose)
+        print(f"== graftlint: {path}")
+        print(text)
+        rc = max(rc, code)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

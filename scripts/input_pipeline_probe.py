@@ -47,9 +47,11 @@ def main() -> None:
     from ray_lightning_accelerators_tpu import (Callback, DataLoader,
                                                 RayTPUAccelerator, Trainer,
                                                 TpuModule)
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
     from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
     from ray_lightning_accelerators_tpu.utils.profiler import Profiler
 
+    cg.install()  # count XLA compiles across the whole probe
     n_devices = jax.device_count()
     batch = 64 * n_devices
     dim, hidden, classes = 256, 1024, 10
@@ -174,6 +176,12 @@ def main() -> None:
         # the driver bar: >= 1.5x steps/s from prefetch on this loader
         "vs_baseline": round(ratio / 1.5, 3),
     }
+    # both timed runs share shapes: compile totals drifting up across
+    # bench rounds means the fit loop started retracing.  Printed BEFORE
+    # the metric record: bench.py takes the LAST JSON line of probe
+    # stdout as the bench result.
+    print(json.dumps(cg.compile_count_record("input_pipeline")),
+          flush=True)
     print(json.dumps(record), flush=True)
 
 

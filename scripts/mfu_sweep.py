@@ -64,12 +64,14 @@ VARIANTS = {
 }
 
 
-def run_variant(name: str, spec: dict) -> dict:
+def run_variant(name: str, spec: dict) -> tuple:
     # the measurement itself lives in bench.py so every sweep number is
     # produced under exactly the timed-window/sync discipline the
     # driver's bench uses (bench-honesty: one shared implementation)
     from bench import _bench_gpt
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
 
+    c0 = cg.compile_count()
     rec = _bench_gpt(loss_chunk=spec["loss_chunk"],
                      flash_block=spec["flash_block"],
                      steps_per_epoch=spec["steps_per_epoch"],
@@ -77,17 +79,25 @@ def run_variant(name: str, spec: dict) -> dict:
                      remat=spec.get("remat", False),
                      remat_policy=spec.get("remat_policy", "nothing"),
                      tiny=spec.get("tiny", False))
-    return {"variant": name, "step_ms": rec["step_ms"],
-            "mfu": rec["mfu"],
-            "tokens_per_sec_per_chip": rec["value"], **spec}
+    # compile-count alongside the metric (bench-honesty tie-in): the
+    # train step must compile a FIXED program count per variant — a
+    # growing number across bench rounds is a retrace regression even
+    # when step_ms still looks plausible
+    compile_rec = dict(cg.compile_count_record(f"mfu_sweep:{name}"),
+                       variant_new_compiles=cg.compile_count() - c0)
+    return ({"variant": name, "step_ms": rec["step_ms"],
+             "mfu": rec["mfu"],
+             "tokens_per_sec_per_chip": rec["value"], **spec},
+            compile_rec)
 
 
 def main() -> None:
     names = sys.argv[1:] or ["tuned", "remat-dots"]
     for name in names:
         try:
-            print(json.dumps(run_variant(name, VARIANTS[name])),
-                  flush=True)
+            metric_rec, compile_rec = run_variant(name, VARIANTS[name])
+            print(json.dumps(metric_rec), flush=True)
+            print(json.dumps(compile_rec), flush=True)
         except Exception as e:
             print(json.dumps({"variant": name, "error":
                               f"{type(e).__name__}: {e}"[:500]}),

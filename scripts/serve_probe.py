@@ -12,12 +12,18 @@ Usage::
 
     python scripts/serve_probe.py [--requests N] [--slots S] [--seed K]
 
-Output (one line)::
+Output (metric line + compile-count line)::
 
     {"probe": "serve", "requests": ..., "max_slots": ...,
      "throughput_tok_s": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
      "token_p50_ms": ..., "token_p99_ms": ..., "token_max_ms": ...,
      "steps": ..., "steps_batch_gt1": ..., "max_batch": ...}
+    {"probe": "serve", "kind": "compile_count",
+     "total_backend_compiles": ..., "measured_window_compiles": 0}
+
+A nonzero ``measured_window_compiles`` means the engine retraced inside
+the measured window — the 3-program invariant broke (see
+analysis/compile_guard.py; tests/test_analysis.py asserts it too).
 """
 
 import json
@@ -34,13 +40,16 @@ def _arg(flag: str, default: int) -> int:
     return default
 
 
-def probe(n_requests: int, max_slots: int, seed: int) -> dict:
+def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
     import jax
     import numpy as np
 
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
     from ray_lightning_accelerators_tpu.models.transformer import (
         GPT, TransformerConfig)
     from ray_lightning_accelerators_tpu.serve import ServeEngine
+
+    cg.install()  # count XLA compiles from before the first trace
 
     cfg = TransformerConfig(vocab_size=512, d_model=128, n_heads=4,
                             d_ff=256, n_layers=4, max_seq_len=256)
@@ -65,18 +74,20 @@ def probe(n_requests: int, max_slots: int, seed: int) -> dict:
                              size=(max(1, s0 - 1),)).astype(np.int32)
             engine.submit(p, 2).result(timeout=600)
         engine.metrics.profiler.reset()
+        window_start = cg.compile_count()  # warmup done: window begins
 
         handles = [engine.submit(p, int(rng.integers(8, 33)))
                    for p in prompts(n_requests)]
         for h in handles:
             h.result(timeout=600)
         snap = engine.stats()
+        compile_rec = cg.compile_count_record("serve", window_start)
 
     def ms(fam, key):
         row = snap.get(fam) or {}
         return round(1e3 * row.get(key, 0.0), 3)
 
-    return {
+    return compile_rec, {
         "probe": "serve", "requests": n_requests, "max_slots": max_slots,
         "tokens_generated": snap["tokens_generated"],
         "busy_s": round(snap["busy_s"], 3),
@@ -94,13 +105,18 @@ def probe(n_requests: int, max_slots: int, seed: int) -> dict:
 
 
 def main() -> None:
+    compile_rec = None
     try:
-        rec = probe(_arg("--requests", 16), _arg("--slots", 4),
-                    _arg("--seed", 0))
+        compile_rec, rec = probe(_arg("--requests", 16), _arg("--slots", 4),
+                                 _arg("--seed", 0))
     except Exception as e:
         rec = {"probe": "serve",
                "error": f"{type(e).__name__}: {e}"[:400]}
     print(json.dumps(rec), flush=True)
+    if compile_rec is not None:
+        # a measured-window compile count > 0 means the decode loop
+        # retraced mid-flight — visible here even when nothing asserts
+        print(json.dumps(compile_rec), flush=True)
 
 
 if __name__ == "__main__":

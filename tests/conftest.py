@@ -38,6 +38,21 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def compile_guard():
+    """The compile-count guard factory (analysis/compile_guard.py):
+
+        with compile_guard(max_new_compiles=3, label="serve"):
+            ...  # raises CompileBudgetExceeded past the budget
+
+    Counting is process-global (one jax.monitoring listener installed on
+    first use), so guarded blocks must not overlap other tests' compiles
+    — fine under the suite's in-process sequential execution."""
+    from ray_lightning_accelerators_tpu.analysis.compile_guard import (
+        compile_guard as guard)
+    return guard
+
+
+@pytest.fixture
 def cpu_mesh_subprocess():
     """Run a python script in a SPAWNED subprocess whose backend comes up
     with an 8-device virtual CPU mesh.

@@ -271,3 +271,24 @@ def test_isolated_mode_passes_through_child_records(monkeypatch,
     rec = json.loads(capsys.readouterr().out.splitlines()[-1])
     assert rec == {"metric": "selftest", "value": 1, "unit": "ok",
                    "vs_baseline": 1.0}
+
+
+def test_last_metric_record_skips_compile_count_lines():
+    # probes print a bench-honesty compile-count record alongside the
+    # metric; whichever order they land in, the bench result must be the
+    # record that actually carries a value
+    metric = {"metric": "wire_bytes", "value": 3.9, "unit": "x",
+              "vs_baseline": 0.98}
+    compile_rec = {"probe": "gradexchange", "kind": "compile_count",
+                   "total_compiles": 7}
+    out = "\n".join(["warmup chatter",
+                     json.dumps(metric),
+                     json.dumps(compile_rec)])
+    assert bench._last_metric_record(out) == metric
+    out = "\n".join([json.dumps(compile_rec), json.dumps(metric)])
+    assert bench._last_metric_record(out) == metric
+    # no metric record at all: newest JSON line still surfaces (error
+    # records), and pure chatter yields None
+    assert bench._last_metric_record(
+        json.dumps(compile_rec))["kind"] == "compile_count"
+    assert bench._last_metric_record("no json here") is None
