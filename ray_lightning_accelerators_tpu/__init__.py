@@ -25,7 +25,9 @@ from .runtime.session import get_actor_rank, init_session, put_queue
 from .utils.profiler import Profiler, device_memory_stats
 from . import models  # lazy family exports (models/__init__.py PEP 562)
 from . import serve
+from . import telemetry
 from .serve import ServeEngine, ServeReplicas
+from .telemetry import FlightRecorder, MetricsRegistry
 from . import tune
 from .tune import TuneReportCallback, TuneReportCheckpointCallback
 from .utils import schedules
@@ -47,5 +49,6 @@ __all__ = [
     "Profiler", "device_memory_stats",
     "models", "schedules",
     "serve", "ServeEngine", "ServeReplicas",
+    "telemetry", "FlightRecorder", "MetricsRegistry",
     "tune", "TuneReportCallback", "TuneReportCheckpointCallback",
 ]

@@ -121,6 +121,10 @@ _register(Knob("RLA_TPU_GLOBAL_SEED", "int", None,
 _register(Knob("RLA_TPU_INSIDE_WORKER", "bool", False,
                "set in spawned workers so nested code never re-launches "
                "a world (core/trainer.py, runtime)"))
+_register(Knob("RLA_TPU_LOG_JSON", "bool", False,
+               "structured-JSON log lines (one object per line with "
+               "ts/level/rank/pid/msg) instead of the human formatter "
+               "(utils/logging.py)"))
 _register(Knob("RLA_TPU_LOG_LEVEL", "str", "WARNING",
                "package logger level; unknown names warn and default "
                "(utils/logging.py)"))
@@ -130,9 +134,27 @@ _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
 _register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
                "preemption grace budget in seconds; setting it installs "
                "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_TELEMETRY", "bool", True,
+               "enable the flight recorder; 0 makes every emit a no-op "
+               "(telemetry/recorder.py)"))
+_register(Knob("RLA_TPU_TELEMETRY_DIR", "str", None,
+               "directory for per-rank flight-recorder spill files "
+               "(rank{N}.events.json) — the crash-observable channel the "
+               "watchdog/agent/run-report read (telemetry/recorder.py)"))
+_register(Knob("RLA_TPU_TELEMETRY_EVENTS", "int", 256,
+               "flight-recorder ring capacity in events "
+               "(telemetry/recorder.py)"))
+_register(Knob("RLA_TPU_TELEMETRY_SPILL_S", "float", 0.5,
+               "minimum seconds between flight-recorder spills; the "
+               "first emit always spills (telemetry/recorder.py)"))
 _register(Knob("RLA_TPU_TEST_PLATFORM", "str", "cpu",
                "platform the test suite binds (tests/conftest.py); "
                "'tpu' gates real-chip runs", scope="tests"))
+_register(Knob("RLA_TPU_TRACE_ID", "str", None,
+               "ambient trace id a spawned process stamps on its "
+               "flight-recorder events — set in env_per_worker so one "
+               "run correlates across driver/agent/workers "
+               "(telemetry/recorder.py)"))
 _register(Knob("RLA_TPU_WEDGE_TIMEOUT_S", "float", None,
                "stale-heartbeat threshold; setting it arms the watchdog "
                "(runtime/watchdog.py)"))

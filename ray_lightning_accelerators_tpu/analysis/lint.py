@@ -341,6 +341,9 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
                         "Trainer._place_train_item"),
     "serve/engine.py": ("ServeEngine._run",),
     "utils/profiler.py": ("Profiler.span",),
+    # the flight recorder's emit runs inside every other hot root: it
+    # must never host-sync or allocate unboundedly (telemetry/)
+    "telemetry/recorder.py": ("FlightRecorder.emit",),
 }
 
 # modules whose code runs inside dispatched workers: typed exceptions

@@ -42,6 +42,7 @@ from ..analysis import knobs
 from ..data import prefetch as prefetch_lib
 from ..data.loader import DataLoader
 from ..parallel import mesh as mesh_lib
+from ..telemetry import recorder as telemetry
 from ..utils import checkpoint as ckpt_lib
 from ..utils.logging import CSVLogger, InMemoryLogger, Logger, log
 from ..utils.profiler import Profiler
@@ -209,6 +210,14 @@ class Trainer:
         # death-record shape, runtime/watchdog.stall_record); None while
         # no supervised run has failed
         self.last_stall_diagnosis: Optional[Dict[str, Any]] = None
+        # telemetry (telemetry/): trace id minted per fit on the driver,
+        # adopted from the ambient recorder inside fanned-out workers (the
+        # pickled trainer carries it across the agent execute op, so one
+        # fit is one trace on every process); per-rank telemetry snapshots
+        # returned by a fan-out land in _rank_telemetry for
+        # build_metrics_registry() to merge
+        self.trace_id: Optional[str] = None
+        self._rank_telemetry: Dict[Any, Optional[Dict[str, Any]]] = {}
         # preemption drain (runtime/preemption.py): bound at fit start
         # when RLA_TPU_PREEMPT_GRACE_S is configured (None otherwise —
         # zero per-step overhead); the step loop polls it and drains into
@@ -345,7 +354,11 @@ class Trainer:
             "preemption notice (%s): draining at step %d (grace %.1fs, "
             "%.1fs remaining)", notice.source, self.global_step,
             notice.grace_s(), notice.remaining_s() or 0.0)
+        telemetry.emit("preempt_drain", step=self.global_step,
+                       source=notice.source)
         path = self._emergency_checkpoint()
+        telemetry.emit("emergency_checkpoint", step=self.global_step,
+                       path=path)
         self.fitting = False
         raise preempt_lib.Preempted.at_step(
             self.global_step, path, source=notice.source or "notice")
@@ -1144,6 +1157,11 @@ class Trainer:
                 self.last_stall_diagnosis = record
                 log.error("stall diagnosis: %s",
                           json.dumps(record, sort_keys=True, default=str))
+            # postmortem artifact: the pool is already gone (world.run
+            # kills it on failure), so rank timelines come from the
+            # telemetry-dir spill files — the channel built to survive
+            # exactly this
+            self._write_failure_report(e)
             raise
 
     def shutdown_workers(self) -> None:
@@ -1164,6 +1182,10 @@ class Trainer:
         n = spec["num_processes"]
         log.warning("fanning fit out to %d processes via agents %s",
                     n, spec.get("agents"))
+        # the trace was minted at fit() entry, before the trainer ships:
+        # the pickled trainer carries it through the agent execute op,
+        # so every worker's events and the driver's share one id
+        telemetry.emit("fit_start", fanout=n)
         world = self._acquire_world(spec)
         self._strip_for_shipment(module)
 
@@ -1177,6 +1199,12 @@ class Trainer:
                                  world.ship_value(datamodule), ckpt_path)
         results = self._run_in_world(world, module, body, queue,
                                      stage="fit")
+
+        # per-rank telemetry (profiler exports + event tails) shipped
+        # home by every rank — build_metrics_registry merges them
+        self._rank_telemetry = {
+            i: (r or {}).get("telemetry") for i, r in enumerate(results)}
+        telemetry.emit("fit_end", fanout=n)
 
         # re-hydrate rank-0 state into the driver's trainer + module
         # (reference: ray_ddp.py:185-193)
@@ -1214,6 +1242,9 @@ class Trainer:
         n = spec["num_processes"]
         log.warning("fanning %s out to %d processes via agents %s",
                     stage, n, spec.get("agents"))
+        # eval fan-outs are runs too: a failure report from a fanned-out
+        # validate/test/predict must carry ITS trace id, not a stale one
+        self._bind_trace()
         world = self._acquire_world(spec)
         self._strip_for_shipment(module)
 
@@ -1224,6 +1255,10 @@ class Trainer:
         results = self._run_in_world(world, module, body, queue,
                                      stage=stage)
 
+        # eval fan-outs ship per-rank telemetry home exactly like fit
+        # (_bind_trace cleared the previous run's; this stage is the run)
+        self._rank_telemetry = {
+            i: (r or {}).get("telemetry") for i, r in enumerate(results)}
         module.trainer = self
         self.module = module
         if stage == "predict":
@@ -1237,11 +1272,117 @@ class Trainer:
     def fit(self, module: TpuModule,
             train_dataloaders=None, val_dataloaders=None,
             datamodule=None, ckpt_path: Optional[str] = None) -> None:
-        plan = self._launch_plan()
-        if plan is not None:
-            return self._fit_via_launcher(plan, module, train_dataloaders,
-                                          val_dataloaders, datamodule,
-                                          ckpt_path)
+        try:
+            # bound BEFORE anything that can raise: a failure in
+            # launch-plan resolution must be reported under THIS run's
+            # fresh trace, not the previous fit's id/telemetry
+            self._bind_trace()
+            plan = self._launch_plan()
+            if plan is not None:
+                return self._fit_via_launcher(plan, module,
+                                              train_dataloaders,
+                                              val_dataloaders, datamodule,
+                                              ckpt_path)
+            return self._fit_local(module, train_dataloaders,
+                                   val_dataloaders, datamodule, ckpt_path)
+        except BaseException as e:
+            # crash postmortem (telemetry/registry.py): a WorkerWedged,
+            # Preempted or any uncaught fit exception leaves a
+            # run_report.json under the run dir — the typed error plus
+            # this process's event timeline and metric snapshot —
+            # before re-raising untouched (_run_in_world may already
+            # have written it; _write_failure_report dedupes)
+            self._write_failure_report(e)
+            raise
+
+    def _bind_trace(self) -> None:
+        """One fit = one trace id.  Inside a fanned-out worker the
+        ambient id (stamped by ``_remote_fit_worker`` from the pickled
+        trainer, or by the ``RLA_TPU_TRACE_ID`` env overlay at worker
+        boot) wins, so driver and workers correlate; a driver fit mints
+        a fresh id and makes it ambient for everything this process
+        emits during the run."""
+        if knobs.get_bool("RLA_TPU_INSIDE_WORKER"):
+            # the driver's id arrives ambient (stamped by
+            # _remote_fit_worker or the boot env overlay) or rides the
+            # pickled trainer itself; mint only if neither made it over
+            self.trace_id = (telemetry.current_trace_id() or self.trace_id
+                             or telemetry.mint_trace_id())
+        else:
+            self.trace_id = telemetry.mint_trace_id()
+            # one run = one registry: a later run's failure report must
+            # not merge a previous fan-out's per-rank telemetry under
+            # the fresh trace id
+            self._rank_telemetry = {}
+        telemetry.set_trace_id(self.trace_id)
+
+    def _write_failure_report(self, exc: BaseException) -> None:
+        """Best-effort ``run_report.json`` under ``default_root_dir``:
+        never raises over the fit's real exception."""
+        if knobs.get_bool("RLA_TPU_INSIDE_WORKER"):
+            # only the driver writes the report: N failing ranks racing
+            # one shared path would clobber the driver's complete report
+            # with partial rank-local data mislabeled "driver" — worker
+            # failures reach the driver typed over the pipe and their
+            # events via the spill dir
+            return
+        if getattr(exc, "_rla_report_written", False):
+            return  # _run_in_world already wrote this failure's report
+        try:
+            from ..telemetry import registry as treg
+            treg.write_run_report(
+                os.path.join(self.default_root_dir, "run_report.json"),
+                error=exc, trace_id=self.trace_id,
+                rank_events=treg.gather_spill_dir(),
+                stall_diagnosis=self.last_stall_diagnosis,
+                registry=self.build_metrics_registry(),
+                extra={"global_step": self.global_step,
+                       "epoch": self.current_epoch})
+            try:
+                exc._rla_report_written = True
+            except Exception:
+                pass  # __slots__ exceptions: worst case a double write
+        except BaseException as e:
+            log.warning("failed to write fit run report: %s", e)
+
+    def build_metrics_registry(self) -> "Any":
+        """This run's unified :class:`~..telemetry.registry
+        .MetricsRegistry`: the driver profiler (spans, prefetch
+        counters/gauges, comms wire record), every fanned-out rank's
+        profiler export (merged with reservoir-correct semantics),
+        this process's flight-recorder event tallies and the backend
+        compile count.  Serve metrics join via
+        ``registry.add_serve(engine.metrics)`` — serving runs outside
+        the trainer."""
+        from ..telemetry.registry import MetricsRegistry
+        reg = MetricsRegistry(trace_id=self.trace_id)
+        if self.profiler is not None:
+            reg.add_profiler(self.profiler, rank="driver")
+        elif self.comms_per_step:
+            # no profiler attached: the comms record still belongs in
+            # the export (it is analytic, computed at compile time)
+            from ..utils.profiler import Profiler
+            p = Profiler()
+            p.record_comms(self.comms_per_step)
+            reg.add_profiler(p, rank="driver")
+        for rank, snap in self._rank_telemetry.items():
+            if not snap:
+                continue
+            if snap.get("profiler"):
+                reg.add_profiler(snap["profiler"], rank=rank)
+            if snap.get("events"):
+                reg.add_events(snap["events"], rank=rank)
+        reg.add_events(telemetry.get_recorder().events(), rank="driver")
+        try:
+            reg.add_compile_count(rank="driver")
+        except BaseException:  # monitoring unavailable: export without it
+            pass
+        return reg
+
+    def _fit_local(self, module: TpuModule,
+                   train_dataloaders=None, val_dataloaders=None,
+                   datamodule=None, ckpt_path: Optional[str] = None
+                   ) -> None:
         self.accelerator.validate_process_topology()
         t0 = time.perf_counter()
         self.fitting = True
@@ -1266,6 +1407,8 @@ class Trainer:
         self.accelerator.setup_environment()
         self._mesh = self.accelerator.build_mesh()
         self._bind_preemption()
+        telemetry.emit("fit_start", step=self.global_step,
+                       processes=jax.process_count())
 
         # sampler auto-injection (reference: ray_ddp.py:280-295)
         if self.accelerator.require_distributed_sampler:
@@ -1425,6 +1568,9 @@ class Trainer:
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
         self.fit_duration_s = time.perf_counter() - t0
+        telemetry.emit("fit_end", step=self.global_step,
+                       epochs=self.epochs_completed,
+                       duration_s=round(self.fit_duration_s, 3))
 
     def _fit_step(self, state, kind, payload, pf, module,
                   batch_idx: int):
@@ -1460,6 +1606,10 @@ class Trainer:
                     h.set(train_metrics)
         self.global_step += 1
         self._state = state
+        # flight-recorder step event: host ints only (graftlint pins this
+        # path sync-free; a device value here would also be one)
+        telemetry.emit("train_step", step=self.global_step,
+                       batch=batch_idx, epoch=self.current_epoch)
         for c in self.callbacks:
             c.on_train_batch_end(self, module, train_metrics,
                                  batch_idx)
@@ -1499,6 +1649,8 @@ class Trainer:
             module.on_validation_epoch_end()
             for c in self.callbacks:
                 c.on_validation_end(self, module)
+            telemetry.emit("validation", step=self.global_step,
+                           epoch=self.current_epoch)
         for c in self.callbacks:
             c.on_train_epoch_end(self, module)
         if not run_val and self._val_loader is None:
@@ -1507,6 +1659,8 @@ class Trainer:
             for c in self.callbacks:
                 c.on_validation_end(self, module)
         self.current_epoch += 1
+        telemetry.emit("epoch_end", epoch=self.current_epoch,
+                       step=self.global_step)
         if self.enable_progress_bar:
             log.warning("epoch %d done (step %d) metrics=%s",
                         self.current_epoch, self.global_step,
@@ -1697,6 +1851,7 @@ class Trainer:
                 c.on_test_end(self, module)
             elif stage == "validate":
                 c.on_validation_end(self, module)
+        telemetry.emit("validation", stage=stage, step=self.global_step)
         return [metrics]
 
     def validate(self, module: TpuModule, dataloaders=None,
@@ -1904,6 +2059,20 @@ def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
     dataloaders = resolve_shipped(dataloaders)
     datamodule = resolve_shipped(datamodule)
     os.environ["RLA_TPU_INSIDE_WORKER"] = "1"
+    if trainer.trace_id:
+        # same contract as _remote_fit_worker: the driver's per-stage
+        # trace id rides the pickled trainer; make it ambient so this
+        # rank's events correlate with the driver's timeline
+        telemetry.set_trace_id(trainer.trace_id)
+
+    def telemetry_snap():
+        # per-rank home-ship, the eval analog of _remote_fit_worker's:
+        # the driver's MetricsRegistry merges every rank's view
+        return {"rank": process_id,
+                "profiler": (trainer.profiler.export_state()
+                             if trainer.profiler is not None else None),
+                "events": telemetry.get_recorder().events()}
+
     if stage == "predict":
         if datamodule is not None:
             datamodule.setup("predict")
@@ -1920,7 +2089,8 @@ def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
                 # sampler's wrap-padding after re-interleaving
                 "dataset_len": (len(dataloaders.dataset)
                                 if isinstance(dataloaders, DataLoader)
-                                else None)}
+                                else None),
+                "telemetry": telemetry_snap()}
     if stage == "validate":
         results = trainer.validate(module, dataloaders,
                                    datamodule=datamodule)
@@ -1932,7 +2102,8 @@ def _remote_eval_worker(trainer: "Trainer", module, dataloaders, datamodule,
             metrics[k] = float(v)
         except (TypeError, ValueError):
             pass
-    return {"metrics": metrics, "results": results}
+    return {"metrics": metrics, "results": results,
+            "telemetry": telemetry_snap()}
 
 
 def _interleave_predictions(per_rank: List[List[Any]],
@@ -1998,8 +2169,21 @@ def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,
     val_dataloaders = resolve_shipped(val_dataloaders)
     datamodule = resolve_shipped(datamodule)
     os.environ["RLA_TPU_INSIDE_WORKER"] = "1"
+    if trainer.trace_id:
+        # the driver's per-fit trace id arrived on the pickled trainer
+        # (through the agent execute op); make it ambient so every event
+        # this worker emits correlates with the driver's timeline
+        telemetry.set_trace_id(trainer.trace_id)
     trainer.fit(module, train_dataloaders, val_dataloaders,
                 datamodule=datamodule, ckpt_path=ckpt_path)
+    # per-rank telemetry home-ship: the profiler's raw-reservoir export
+    # (Profiler.merge-able driver-side) + this rank's recent events
+    telemetry_snap = {
+        "rank": process_id,
+        "profiler": (trainer.profiler.export_state()
+                     if trainer.profiler is not None else None),
+        "events": telemetry.get_recorder().events(),
+    }
 
     def host(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
@@ -2013,7 +2197,10 @@ def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,
 
     params_host = jax.tree.map(host, module.params)
     if jax.process_index() != 0:
-        return None  # rank-0-only result (reference: ray_horovod.py:160-162)
+        # non-zero ranks used to return None; they now ship their (small)
+        # telemetry snapshot so the driver's MetricsRegistry merges EVERY
+        # rank's profiler/events, not rank 0's view of the run
+        return {"telemetry": telemetry_snap}
     metrics = {}
     for k, v in trainer.callback_metrics.items():
         try:
@@ -2028,4 +2215,5 @@ def _remote_fit_worker(trainer: "Trainer", module, train_dataloaders,
             "epochs_completed": trainer.epochs_completed,
             "metrics": metrics,
             "callbacks": {k: v for k, v in cb_states.items() if v},
-            "best_model_path": best}
+            "best_model_path": best,
+            "telemetry": telemetry_snap}

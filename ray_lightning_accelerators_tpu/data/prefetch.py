@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 
 # producer stop-check cadence while blocked on a full queue: close()
@@ -278,6 +279,11 @@ class DevicePrefetcher:
                                                   if hasattr(self._inner,
                                                              "qsize")
                                                   else 0))
+            if starved:
+                # flight-recorder breadcrumb: the counter says HOW OFTEN
+                # the run starved, the event says WHEN in the timeline
+                telemetry.emit("prefetch_starved",
+                               wait_ms=round(wait * 1e3, 3))
             self._started = True
             return self._ring.popleft()
         if self._pending_exc is not None:

@@ -52,6 +52,24 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
                  heartbeat: Optional[HeartbeatChannel] = None,
                  heartbeat_s: float = 0.0) -> None:
     os.environ.update(env)
+    # flight recorder (telemetry/recorder.py): rank-keyed so the spill
+    # file and every event carry this worker's identity; the trace id /
+    # telemetry dir come from the per-worker env overlay.  A failure
+    # here must not take the worker down — telemetry observes, never
+    # gates.
+    try:
+        from ..telemetry import recorder as telemetry
+        telemetry.configure(rank=rank, env=env)
+    except Exception:
+        telemetry = None
+    try:
+        # the package logger was configured at import, BEFORE the
+        # per-worker overlay landed in os.environ — re-read
+        # RLA_TPU_LOG_JSON / RLA_TPU_LOG_LEVEL so overlays are honored
+        from ..utils.logging import configure_logging
+        configure_logging()
+    except Exception:
+        pass
     # a device plugin loaded from sitecustomize may have forced
     # jax_platforms via CONFIG during interpreter startup; the
     # environment's explicit choice must win (per-worker env first, then
@@ -90,6 +108,7 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
                 rank, freeze_heartbeat=beat.freeze if beat else None)
         except BaseException as e:
             chaos_error = e
+    n_dispatch = 0
     while True:
         try:
             blob = conn.recv_bytes()
@@ -98,6 +117,13 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
         if blob == _SENTINEL:
             conn.close()
             return
+        n_dispatch += 1
+        if telemetry is not None:
+            # emitted BEFORE chaos/user code runs, and the recorder's
+            # first emit spills eagerly: a rank that hangs or dies inside
+            # this dispatch leaves "it entered dispatch N" on disk — the
+            # tail the watchdog embeds into WorkerWedged.diagnosis
+            telemetry.emit("dispatch_begin", n=n_dispatch)
         try:
             if chaos_error is not None:
                 raise chaos_error
@@ -132,6 +158,9 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
             notice.busy = False
         if beat is not None:
             beat.end_dispatch()
+        if telemetry is not None:
+            telemetry.emit("dispatch_end", n=n_dispatch,
+                           ok=payload[0] == "ok")
         conn.send_bytes(cloudpickle.dumps(payload))
 
 
@@ -317,6 +346,16 @@ class Worker:
                     fut.set_exception(RuntimeError(
                         f"failed to deserialize result from worker "
                         f"{self.rank}: {type(e).__name__}: {e}"))
+
+    def telemetry_tail(self) -> Optional[Dict[str, Any]]:
+        """This rank's spilled flight-recorder snapshot (telemetry/
+        recorder.py), read from the shared ``RLA_TPU_TELEMETRY_DIR``
+        spill file — works even when the worker is wedged or dead,
+        which is exactly when the watchdog asks.  None when no
+        telemetry dir is configured or the rank never spilled."""
+        from ..telemetry.recorder import read_spill, spill_path_for
+        path = spill_path_for(self.rank, env=self._env)
+        return read_spill(path) if path else None
 
     # parity surface (reference: ray_ddp.py:21-27)
     def set_env_var(self, key: str, value: str) -> Future:

@@ -247,6 +247,14 @@ class HostAgent:
                         hb = getattr(worker, "heartbeat", None)
                         reply(req_id, "ok",
                               None if hb is None else hb.snapshot())
+                    elif op == "telemetry":
+                        # the worker's spilled flight-recorder tail, read
+                        # agent-side (the spill file lives on THIS host).
+                        # Works on a wedged/dead worker — the file is the
+                        # part of the rank that survives it.
+                        reply(req_id, "ok",
+                              None if worker is None
+                              else worker.telemetry_tail())
                     elif op == "reap":
                         if worker is not None:
                             worker.reap(payload)
@@ -459,6 +467,15 @@ class RemoteWorker:
             self._conn.call("reap", diagnosis, timeout=30)
         except BaseException:
             pass  # agent gone: the lost connection already failed futures
+
+    def telemetry_tail(self) -> Optional[Dict]:
+        """This rank's spilled flight-recorder snapshot, fetched through
+        the agent (the spill file lives on the remote host).  None on
+        any failure — telemetry degrades, never blocks supervision."""
+        try:
+            return self._conn.call("telemetry", timeout=10)
+        except BaseException:
+            return None
 
     def set_env_var(self, key: str, value: str) -> Future:
         return self.execute(_set_env_remote, key, value)

@@ -44,6 +44,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..analysis import knobs
+from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 from . import preemption as preempt_lib
 from .actors import ActorPool
@@ -90,7 +91,8 @@ class ElasticRunner:
                  min_workers: int = 1,
                  probe_timeout_s: float = 120.0,
                  max_preemptions: int = 3,
-                 backoff_cap_s: float = DEFAULT_BACKOFF_CAP_S):
+                 backoff_cap_s: float = DEFAULT_BACKOFF_CAP_S,
+                 report_dir: Optional[str] = None):
         """``max_failures``: attempts beyond the first before giving up.
         ``on_failure(attempt, exc)``: observer hook per failed attempt.
         ``init_hook``: re-run on restarted workers before re-dispatch
@@ -115,8 +117,16 @@ class ElasticRunner:
         budget for the dispatched fn), or the ``RLA_TPU_WEDGE_TIMEOUT_S``
         env is set: each attempt is watched by a `runtime.watchdog
         .Watchdog`, wedged ranks are reaped, and the attempt fails
-        retryably with ``WorkerWedged`` instead of hanging forever."""
+        retryably with ``WorkerWedged`` instead of hanging forever.
+
+        ``report_dir``: when set, every failed attempt (and a terminal
+        preemption — driver hand-up or exhausted ``max_preemptions``
+        budget) writes a ``run_report.json`` postmortem
+        there — per-rank flight-recorder timelines, the failure, the
+        wedge diagnosis (telemetry/registry.py); the newest failure
+        wins the file."""
         self.pool = pool
+        self.report_dir = report_dir
         self.max_failures = max_failures
         self.backoff_s = knobs.get_float(BACKOFF_BASE_ENV, backoff_s)
         self.backoff_cap_s = knobs.get_float(BACKOFF_CAP_ENV,
@@ -143,6 +153,26 @@ class ElasticRunner:
         # configured, so a driver SIGTERM ends the retry loop instead of
         # respawning workers on a host that is going away
         self._notice = preempt_lib.install_from_env()
+
+    def _write_report(self, exc: BaseException) -> None:
+        """Postmortem artifact for a failed/preempted attempt (no-op
+        without ``report_dir``): driver timeline + every rank's spill
+        tail + the typed failure, via telemetry.write_run_report.
+        Best-effort by contract — it must never mask ``exc``."""
+        if not self.report_dir:
+            return
+        try:
+            from ..telemetry import registry as treg
+            stall = getattr(exc, "diagnosis", None) or (
+                self.wedge_events[-1] if self.wedge_events else None)
+            treg.write_run_report(
+                self.report_dir, error=exc,
+                rank_events=treg.gather_worker_tails(self.pool.workers),
+                stall_diagnosis=stall,
+                extra={"attempts_used": self.attempts_used,
+                       "world_size": len(self.pool)})
+        except BaseException as e:
+            log.warning("elastic run-report write failed: %s", e)
 
     def _supervised(self) -> bool:
         return (self.wedge_timeout_s is not None
@@ -208,6 +238,7 @@ class ElasticRunner:
             event = {"dropped": dropped, "world_size": len(self.pool),
                      "attempt": attempt + 1}
             self.shrink_events.append(event)
+            telemetry.emit("elastic_shrink", **event)
             log.warning("elastic scale-down: %s", event)
         if self.init_hook is not None:
             for f in self.pool.execute_all(self.init_hook):
@@ -232,6 +263,8 @@ class ElasticRunner:
         preemptions = 0
         while True:
             self.attempts_used = attempt + 1
+            telemetry.emit("elastic_attempt", attempt=attempt + 1,
+                           world_size=len(self.pool))
             if attempt > 0:
                 # restart every rank, not just dead ones: survivors of a
                 # broken collective (and watchdog-reaped wedges' peers)
@@ -273,14 +306,21 @@ class ElasticRunner:
                     # state is checkpointed, the budget stays intact
                     preempted = preempt_lib.as_preempted(e)
                     self.preempt_events.append(preempted)
+                    telemetry.emit("elastic_preempt_resume",
+                                   attempt=attempt + 1,
+                                   step=getattr(preempted, "step", None))
                     if (self._notice is not None
                             and self._notice.requested()):
                         # the DRIVER is being preempted too: hand the
                         # typed outcome up instead of respawning workers
                         # on a host that is going away
+                        self._write_report(preempted)
                         raise preempted from e
                     preemptions += 1
                     if preemptions > self.max_preemptions:
+                        # terminal exit: like the failure-budget path, it
+                        # must leave a postmortem when report_dir is set
+                        self._write_report(preempted)
                         raise RuntimeError(
                             f"elastic run preempted {preemptions} times "
                             f"(max_preemptions={self.max_preemptions})"
@@ -290,8 +330,12 @@ class ElasticRunner:
                                 attempt + 1, preempted)
                 else:
                     failures += 1
+                    telemetry.emit("elastic_failure",
+                                   attempt=attempt + 1,
+                                   error=type(e).__name__)
                     if self.on_failure is not None:
                         self.on_failure(attempt, e)
+                    self._write_report(e)
                     if failures > self.max_failures:
                         break
             finally:

@@ -46,6 +46,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..analysis import knobs
+from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 
 HEARTBEAT_ENV = "RLA_TPU_WORKER_HEARTBEAT_S"
@@ -304,7 +305,7 @@ class Watchdog:
 
     def _diagnosis(self, worker: Any,
                    info: Dict[str, Any]) -> Dict[str, Any]:
-        return {
+        diagnosis = {
             "error": "worker wedged",
             "rank": worker.rank,
             "state": STATE_WEDGED,
@@ -315,6 +316,22 @@ class Watchdog:
             "wedge_timeout_s": self.wedge_timeout_s,
             "dispatch_deadline_s": self.dispatch_deadline_s,
         }
+        # flight-recorder tail (telemetry/recorder.py): the wedged rank's
+        # last events, read from its spill file — a frozen process can't
+        # answer, the file can.  Embedded here so the typed WorkerWedged
+        # alone is a usable postmortem, across BOTH rebuild paths (local
+        # pipe and agent relay both re-derive diagnosis from the
+        # message's JSON marker, runtime/wire.py).
+        try:
+            tail_fn = getattr(worker, "telemetry_tail", None)
+            snap = tail_fn() if tail_fn is not None else None
+            if snap:
+                diagnosis["events"] = telemetry.tail_events(snap)
+                if snap.get("trace_id"):
+                    diagnosis["trace_id"] = snap["trace_id"]
+        except BaseException:
+            pass  # a postmortem garnish must never block the reap
+        return diagnosis
 
     # -- polling ------------------------------------------------------- #
     def poll_once(self) -> Dict[int, str]:
@@ -337,16 +354,27 @@ class Watchdog:
                 w.reap(diagnosis)
             except BaseException as e:
                 log.warning("reap of worker %d failed: %s", w.rank, e)
+        transitions: List[Tuple[int, Optional[str], str]] = []
         with self._cond:
             for rank, state in new_states.items():
                 old = self._states.get(rank)
-                if old != state and self.on_transition is not None:
-                    try:
-                        self.on_transition(rank, old, state)
-                    except BaseException:
-                        pass
+                if old != state:
+                    transitions.append((rank, old, state))
+                    if self.on_transition is not None:
+                        try:
+                            self.on_transition(rank, old, state)
+                        except BaseException:
+                            pass
             self._states = new_states
             self._cond.notify_all()
+        # emitted OUTSIDE the condition lock: a recorder spill is disk
+        # I/O, and wait_for_state/poll consumers must not stall on it
+        for rank, old, state in transitions:
+            try:
+                telemetry.emit("watchdog_transition", rank=rank,
+                               prev=old, state=state)
+            except BaseException:
+                pass
         return dict(new_states)
 
     def states(self) -> Dict[int, str]:

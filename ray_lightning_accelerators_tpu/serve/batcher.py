@@ -28,6 +28,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, List, Optional, Tuple
 
+from ..telemetry.recorder import mint_trace_id
+
 import numpy as np
 
 from ..runtime.queue import TrampolineQueue
@@ -66,6 +68,11 @@ class ServeRequest:
     max_new_tokens: int
     t_submit: float             # monotonic, stamped at admission
     requeues: int = 0           # infra-failure re-admissions so far
+    # per-request trace id (telemetry/recorder.py): stamped at admission
+    # so every flight-recorder event of this request's lifecycle
+    # (admit -> prefill -> decode -> respond) correlates — across
+    # replicas too, since the id travels with the request on requeue
+    trace_id: Optional[str] = None
 
 
 class ServeResponse:
@@ -166,7 +173,8 @@ class AdmissionController:
             if self._depth >= self.queue_depth:
                 raise QueueFull(self._depth, self.queue_depth)
             req = ServeRequest(next(self._ids), prompt,
-                               int(max_new_tokens), time.monotonic())
+                               int(max_new_tokens), time.monotonic(),
+                               trace_id=mint_trace_id())
             resp = ServeResponse(req)
             self._q.put((req, resp))
             self._depth += 1

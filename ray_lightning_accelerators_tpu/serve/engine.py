@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
                       ServeResponse)
@@ -189,6 +190,11 @@ class ServeEngine:
             self.metrics.inc("rejected")
             raise
         self.metrics.inc("submitted")
+        # per-request trace (minted at admission): the whole
+        # admit -> prefill -> respond lifecycle shares it
+        telemetry.emit("serve_admit", trace=resp.request.trace_id,
+                       request=resp.request.request_id,
+                       prompt_len=int(resp.request.prompt.size))
         return resp
 
     def stats(self) -> Dict[str, Any]:
@@ -277,6 +283,9 @@ class ServeEngine:
             resp.ttft_s = now - req.t_submit
             self.metrics.observe_ttft(resp.ttft_s)
             self.metrics.observe_prefill(now - t_a)
+            telemetry.emit("serve_prefill", trace=req.trace_id,
+                           request=req.request_id, bucket=P, slot=i,
+                           ttft_ms=round(resp.ttft_s * 1e3, 3))
             if req.max_new_tokens == 1:
                 self._finish(req, resp, [first])
             else:
@@ -306,6 +315,10 @@ class ServeEngine:
         nxt = np.asarray(toks_next)  # graftlint: ok(host-sync) — feed gate
         now = time.monotonic()
         self.metrics.observe_step(now - t0, len(active))
+        # batched event (one per step, not per slot): slot-level identity
+        # lives in the admit/prefill/respond events' traces
+        telemetry.emit("serve_decode_step", active=len(active),
+                       step_ms=round((now - t0) * 1e3, 3))
         for i in active:
             s = self._slots[i]
             tok = int(nxt[i])
@@ -325,6 +338,9 @@ class ServeEngine:
             [req.prompt, np.asarray(generated, np.int32)])  # no device value
         if resp._complete(tokens):
             self.metrics.inc("completed")
+            telemetry.emit("serve_respond", trace=req.trace_id,
+                           request=req.request_id,
+                           tokens=len(generated))
 
     def _cancel_slots(self) -> None:
         for i, s in enumerate(self._slots):

@@ -129,6 +129,21 @@ class ServeMetrics:
         out["prefill_s"] = pct(self.PREFILL)
         return out
 
+    def reset(self) -> None:
+        """Clear EVERY accumulated structure — counters, max-batch
+        watermark, busy window, and the latency reservoirs (the owned
+        profiler resets too; callers sharing a profiler across engines
+        accept that its other families clear with it).  Probes reset
+        after warmup so the measured window starts from zero; the reset
+        test pins that no field is missed (PR 3/PR 4 each shipped a
+        reset that forgot one)."""
+        with self._lock:
+            self._c = {k: 0 for k in self._COUNTERS}
+            self._max_batch = 0
+            self._t_first = None
+            self._t_last = None
+        self.profiler.reset()
+
     def describe(self) -> str:
         """Human-readable snapshot + the profiler's latency table."""
         snap = self.snapshot()

@@ -1,0 +1,29 @@
+"""Distributed telemetry: flight recorder, trace IDs, unified metrics
+export, and crash postmortem reports.
+
+- :mod:`.recorder` — the bounded per-process event ring with trace-ID
+  propagation and crash-observable spill files;
+- :mod:`.registry` — the driver-side :class:`MetricsRegistry` (merged
+  Profiler/ServeMetrics/compile-count export to Prometheus text and
+  JSON) and the ``run_report.json`` postmortem writer.
+
+See docs/API.md "Telemetry & tracing" for event kinds, propagation
+rules, export formats and the report schema.
+"""
+
+from .recorder import (EMBED_TAIL_N, EVENT_KINDS, FlightRecorder,
+                       configure, current_rank, current_trace_id, emit,
+                       get_recorder, mint_trace_id, read_spill,
+                       set_trace_id, spill_path_for, tail_events)
+from .registry import (MetricsRegistry, build_run_report,
+                       gather_spill_dir, gather_worker_tails,
+                       probe_snapshot_record, write_run_report)
+
+__all__ = [
+    "FlightRecorder", "EVENT_KINDS", "EMBED_TAIL_N",
+    "get_recorder", "configure", "emit",
+    "mint_trace_id", "set_trace_id", "current_trace_id", "current_rank",
+    "spill_path_for", "read_spill", "tail_events",
+    "MetricsRegistry", "gather_worker_tails", "gather_spill_dir",
+    "build_run_report", "write_run_report", "probe_snapshot_record",
+]

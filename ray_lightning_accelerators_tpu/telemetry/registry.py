@@ -1,0 +1,377 @@
+"""Driver-side metrics registry: one export surface for a whole run.
+
+Every per-process island (trainer Profiler spans, prefetch counters,
+comms wire accounting, ServeMetrics, compile counts, flight-recorder
+events) lands here and renders two ways:
+
+- ``to_json()`` — the machine-readable snapshot (bench probes print it
+  as a ``kind="telemetry"`` line next to their metric record);
+- ``prometheus_text()`` — the Prometheus exposition format, so a run is
+  scrapeable with zero extra glue (span families render as summaries
+  with ``quantile`` labels, counters as ``_total``, gauges as gauges).
+
+``write_run_report`` is the crash postmortem: on ``WorkerWedged`` /
+``Preempted`` / any uncaught fit exception the driver writes
+``run_report.json`` — per-rank flight-recorder timelines (driver ring +
+every worker's spill tail), the stall diagnosis, compile counts and the
+metric snapshot — so the artifact alone reconstructs what each rank was
+doing when the run died.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from ..utils.profiler import Profiler
+from . import recorder as recorder_lib
+
+log = logging.getLogger("ray_lightning_accelerators_tpu.telemetry")
+
+REPORT_SCHEMA = 1
+REPORT_BASENAME = "run_report.json"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus-legal metric-name fragment."""
+    clean = _NAME_RE.sub("_", str(name)).strip("_")
+    return clean or "unnamed"
+
+
+class MetricsRegistry:
+    """Accumulates per-rank telemetry into one mergeable view.
+
+    ``add_profiler`` takes a live :class:`~..utils.profiler.Profiler`
+    or its ``export_state()`` dict (the wire shape workers ship home);
+    all profilers merge into ONE (``Profiler.merge`` reservoir
+    semantics), so the exported percentiles summarize the whole run,
+    not one lucky rank.  Serve snapshots, compile counts and event
+    tallies are kept per rank label (``"driver"``, ``"0"``, ...).
+    """
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self._profiler = Profiler()
+        self._profiler_ranks: List[str] = []
+        self._serve: Dict[str, Dict[str, Any]] = {}
+        self._compile: Dict[str, int] = {}
+        self._event_counts: Dict[str, int] = {}
+        self._extra: Dict[str, float] = {}
+
+    @staticmethod
+    def _label(rank: Any) -> str:
+        return "driver" if rank is None else str(rank)
+
+    # ------------------------------------------------------------------ #
+    def add_profiler(self, profiler: Any, rank: Any = None) -> None:
+        """Merge one rank's profiler (object or export_state dict)."""
+        if profiler is None:
+            return
+        self._profiler.merge(profiler)
+        self._profiler_ranks.append(self._label(rank))
+
+    def add_serve(self, metrics: Any, rank: Any = None) -> None:
+        """One rank's ServeMetrics — the object (its latency reservoirs
+        merge into the shared profiler) or a ``snapshot()`` dict."""
+        if metrics is None:
+            return
+        snap = metrics
+        if hasattr(metrics, "snapshot"):
+            snap = metrics.snapshot()
+            prof = getattr(metrics, "profiler", None)
+            if prof is not None:
+                self.add_profiler(prof, rank=rank)
+        self._serve[self._label(rank)] = dict(snap)
+
+    def add_compile_count(self, n: Optional[int] = None,
+                          rank: Any = None) -> None:
+        """A rank's backend-compile total; ``None`` reads this process's
+        ``analysis.compile_guard.compile_count()``."""
+        if n is None:
+            from ..analysis import compile_guard
+            n = compile_guard.compile_count()
+        self._compile[self._label(rank)] = int(n)
+
+    def add_events(self, events: Sequence[Mapping[str, Any]],
+                   rank: Any = None) -> None:
+        """Tally a rank's flight-recorder events into per-kind counts —
+        the registry is a METRICS surface, so rank granularity is
+        deliberately dropped here; full per-rank timelines belong in the
+        run report.  The first traced event seeds ``trace_id`` when the
+        registry was built without one."""
+        del rank  # accepted for signature symmetry with the other adds
+        for e in events or ():
+            kind = e.get("kind", "?")
+            self._event_counts[kind] = self._event_counts.get(kind, 0) + 1
+            if self.trace_id is None and e.get("trace"):
+                self.trace_id = e["trace"]
+
+    def add_scalar(self, name: str, value: float) -> None:
+        """A free-form run-level scalar (probe extras)."""
+        self._extra[str(name)] = float(value)
+
+    def merged_profiler(self) -> Profiler:
+        return self._profiler
+
+    # ------------------------------------------------------------------ #
+    # Exports                                                             #
+    # ------------------------------------------------------------------ #
+    def to_json(self) -> Dict[str, Any]:
+        """Flat JSON snapshot: merged spans/counters/gauges/comms, serve
+        per rank, compile counts, event tallies."""
+        prof = self._profiler
+        out: Dict[str, Any] = {
+            "schema": REPORT_SCHEMA,
+            "trace_id": self.trace_id,
+            "profiler_ranks": list(self._profiler_ranks),
+            "spans": prof.summary(),
+            "counters": prof.counters(),
+            "gauges": prof.gauges(),
+            "comms": prof.comms(),
+            "serve": {k: dict(v) for k, v in self._serve.items()},
+            "compile": {"per_rank": dict(self._compile),
+                        "total_backend_compiles": sum(
+                            self._compile.values())},
+            "events": dict(self._event_counts),
+        }
+        if self._extra:
+            out["extra"] = dict(self._extra)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition text.  Span families are summaries
+        (``quantile`` labels + ``_sum``/``_count``/``_max``); profiler
+        counters and serve counters are ``_total`` counters; gauges and
+        comms fields are gauges.  Rank granularity: serve metrics carry
+        a ``rank`` label; merged profiler families describe the run."""
+        lines: List[str] = []
+        typed: set = set()
+
+        def add(name: str, value: Any, labels: str = "",
+                mtype: Optional[str] = None) -> None:
+            if value is None:
+                return
+            if mtype is not None and name not in typed:
+                # one TYPE line per metric name: exposition parsers
+                # reject duplicates (rank-labeled families repeat names)
+                typed.add(name)
+                lines.append(f"# TYPE {name} {mtype}")
+            lines.append(f"{name}{labels} {float(value):g}")
+
+        spans = self._profiler.summary()
+        if spans:
+            lines.append("# TYPE rla_tpu_span_seconds summary")
+        for span, s in sorted(spans.items()):
+            lab = f'{{span="{_prom_name(span)}"}}'
+            for q, key in (("0.5", "p50_s"), ("0.95", "p95_s"),
+                           ("0.99", "p99_s")):
+                lines.append(
+                    f'rla_tpu_span_seconds{{span="{_prom_name(span)}",'
+                    f'quantile="{q}"}} {s[key]:g}')
+            lines.append(f"rla_tpu_span_seconds_sum{lab} {s['total_s']:g}")
+            lines.append(f"rla_tpu_span_seconds_count{lab} "
+                         f"{s['count']:g}")
+            lines.append(f"rla_tpu_span_seconds_max{lab} {s['max_s']:g}")
+        for name, n in sorted(self._profiler.counters().items()):
+            add(f"rla_tpu_{_prom_name(name)}_total", n, mtype="counter")
+        for name, g in sorted(self._profiler.gauges().items()):
+            add(f"rla_tpu_{_prom_name(name)}", g["last"], mtype="gauge")
+        comms = self._profiler.comms()
+        if comms:
+            for key in ("exchange_bytes_per_step",
+                        "baseline_fp32_bytes_per_step",
+                        "compression_ratio"):
+                if isinstance(comms.get(key), (int, float)):
+                    add(f"rla_tpu_comms_{_prom_name(key)}", comms[key],
+                        mtype="gauge")
+        # key-major: all of a family's rank-labeled samples must be
+        # contiguous — the exposition format forbids interleaving
+        # metric families, and a rank-major loop would split e.g.
+        # serve_completed_total across two rank blocks
+        serve_keys = sorted({k for snap in self._serve.values()
+                             for k, v in snap.items()
+                             if isinstance(v, (int, float))})
+        for key in serve_keys:
+            gauge = key in ("queue_depth", "busy_s", "throughput_tok_s",
+                            "max_batch")
+            name = f"rla_tpu_serve_{_prom_name(key)}"
+            if not gauge:
+                name = f"{name}_total"
+            for rank, snap in sorted(self._serve.items()):
+                val = snap.get(key)
+                if isinstance(val, (int, float)):
+                    add(name, val, f'{{rank="{rank}"}}',
+                        mtype="gauge" if gauge else "counter")
+        if self._compile:
+            add("rla_tpu_backend_compiles_total",
+                sum(self._compile.values()), mtype="counter")
+        for kind, n in sorted(self._event_counts.items()):
+            add("rla_tpu_events_total", n,
+                f'{{kind="{_prom_name(kind)}"}}', mtype="counter")
+        for name, v in sorted(self._extra.items()):
+            add(f"rla_tpu_{_prom_name(name)}", v, mtype="gauge")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# --------------------------------------------------------------------- #
+# Cross-rank event gathering                                             #
+# --------------------------------------------------------------------- #
+def gather_worker_tails(workers: Sequence[Any]) -> Dict[str, Dict[str, Any]]:
+    """Each worker's spilled flight-recorder snapshot, keyed by rank
+    label.  Works on local ``Worker``s and agent ``RemoteWorker``s (both
+    expose ``telemetry_tail``); a rank with no spill (telemetry dir
+    unset, never emitted, host gone with its disk) is simply absent."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for w in workers or ():
+        tail_fn = getattr(w, "telemetry_tail", None)
+        if tail_fn is None:
+            continue
+        try:
+            snap = tail_fn()
+        except BaseException:
+            snap = None
+        if snap:
+            out[str(getattr(w, "rank", "?"))] = snap
+    return out
+
+
+def gather_spill_dir(tdir: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """Every rank snapshot spilled under the telemetry dir (default: the
+    ``RLA_TPU_TELEMETRY_DIR`` knob).  The pool-independent gather — it
+    still works after the world was killed, which is exactly when the
+    run report is written."""
+    from ..analysis import knobs
+    if tdir is None:
+        tdir = knobs.get_str(recorder_lib.DIR_ENV, None)
+    if not tdir or not os.path.isdir(tdir):
+        return {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for fname in sorted(os.listdir(tdir)):
+        if not fname.endswith(".events.json"):
+            continue
+        snap = recorder_lib.read_spill(os.path.join(tdir, fname))
+        if snap is not None:
+            label = fname[:-len(".events.json")]
+            out[label.replace("rank", "", 1) if label.startswith("rank")
+                else label] = snap
+    return out
+
+
+def probe_snapshot_record(probe: str, *, profiler: Any = None,
+                          serve: Any = None,
+                          **extra: Any) -> Dict[str, Any]:
+    """The bench probes' trailing ``kind="telemetry"`` stdout record
+    (scripts/*_probe.py): driver events + compile count (+ optional
+    profiler/serve metrics) as one MetricsRegistry snapshot.  One place
+    holds the line shape, because bench.py's parser contract depends on
+    it: the record must stay value-LESS (no ``value`` key — enforced
+    here) so the newest-value-bearing-line rule keeps returning the
+    probe's real metric record."""
+    reg = MetricsRegistry()
+    if profiler is not None:
+        reg.add_profiler(profiler, rank="driver")
+    if serve is not None:
+        reg.add_serve(serve, rank="driver")
+    reg.add_events(recorder_lib.get_recorder().events(), rank="driver")
+    try:
+        reg.add_compile_count(rank="driver")
+    except BaseException:  # jax.monitoring unavailable: export without
+        pass
+    if "value" in extra:
+        raise ValueError(
+            "a telemetry snapshot record must stay value-less (bench.py "
+            "treats any 'value'-keyed line as the probe's metric)")
+    rec: Dict[str, Any] = {"probe": probe, "kind": "telemetry",
+                           "snapshot": reg.to_json(),
+                           "prometheus_lines": len(
+                               reg.prometheus_text().splitlines())}
+    rec.update(extra)
+    return rec
+
+
+# --------------------------------------------------------------------- #
+# Run report (crash postmortem artifact)                                 #
+# --------------------------------------------------------------------- #
+def build_run_report(*, error: Optional[BaseException] = None,
+                     trace_id: Optional[str] = None,
+                     rank_events: Optional[Mapping[str, Any]] = None,
+                     stall_diagnosis: Optional[Mapping[str, Any]] = None,
+                     registry: Optional[MetricsRegistry] = None,
+                     include_driver: bool = True,
+                     extra: Optional[Mapping[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """The ``run_report.json`` payload.  ``rank_events`` maps rank labels
+    to spill/wire snapshots (or bare event lists); the driver's own ring
+    is added automatically.  Every field is best-effort — a postmortem
+    writer must not raise past the error it documents."""
+    ranks: Dict[str, Dict[str, Any]] = {}
+    if include_driver:
+        rec = recorder_lib.get_recorder()
+        ranks["driver"] = rec.snapshot()
+        if trace_id is None:
+            trace_id = rec.trace_id
+    for label, snap in (rank_events or {}).items():
+        if str(label) in ranks:
+            # the live driver ring already landed; a spill of the same
+            # rank is up to one throttle tick stale — never clobber the
+            # crash-adjacent events with it
+            continue
+        if isinstance(snap, (list, tuple)):
+            snap = {"events": list(snap)}
+        ranks[str(label)] = dict(snap)
+    err = None
+    if error is not None:
+        err = {"type": type(error).__name__,
+               "message": str(error)[:2000],
+               "rank": getattr(error, "rank", None)}
+        diag = getattr(error, "diagnosis", None)
+        if diag:
+            err["diagnosis"] = dict(diag)
+    compiles = None
+    try:
+        from ..analysis import compile_guard
+        compiles = compile_guard.compile_count()
+    except BaseException:  # jax missing/broken: the report still writes
+        pass
+    report: Dict[str, Any] = {
+        "schema": REPORT_SCHEMA,
+        "kind": "run_report",
+        "trace_id": trace_id,
+        "written_unix": time.time(),
+        "error": err,
+        "stall_diagnosis": (dict(stall_diagnosis)
+                            if stall_diagnosis else None),
+        "compile": {"driver_backend_compiles": compiles},
+        "ranks": ranks,
+        "metrics": registry.to_json() if registry is not None else None,
+    }
+    if extra:
+        report["extra"] = dict(extra)
+    return report
+
+
+def write_run_report(path: str, **kwargs: Any) -> Optional[str]:
+    """Write ``build_run_report(**kwargs)`` to ``path`` (a directory gets
+    ``run_report.json`` appended).  Atomic tmp+rename; returns the final
+    path, or None on failure — a postmortem write error is logged, never
+    raised over the run's real exception."""
+    try:
+        report = build_run_report(**kwargs)
+        if os.path.isdir(path) or not path.endswith(".json"):
+            path = os.path.join(path, REPORT_BASENAME)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, default=str)
+        os.replace(tmp, path)
+        log.warning("run report written: %s", path)
+        return path
+    except BaseException as e:
+        log.warning("failed to write run report to %s: %s", path, e)
+        return None

@@ -11,24 +11,86 @@ keeping the XLA pipeline async.
 from __future__ import annotations
 
 import csv
+import json as _json
 import logging
 import os
 from typing import Dict, List, Optional
 
 from ..analysis import knobs
 
+
+class _RankFormatter(logging.Formatter):
+    """Rank/pid-stamped formatter.  The rank comes from the flight
+    recorder's process identity (telemetry/recorder.py, set by the
+    worker boot path) — in a fanned-out run every line says which rank
+    said it, which is the difference between a log and a timeline.
+    ``json_mode`` (``RLA_TPU_LOG_JSON``) renders one JSON object per
+    line (ts/level/logger/rank/pid/msg) for log shippers."""
+
+    def __init__(self, json_mode: bool = False):
+        super().__init__()
+        self.json_mode = json_mode
+
+    @staticmethod
+    def _rank() -> str:
+        try:
+            # lazy: telemetry.recorder imports knobs, never this module,
+            # so the late import cannot cycle
+            from ..telemetry.recorder import current_rank
+            rank = current_rank()
+        except Exception:
+            rank = None
+        return "driver" if rank is None else str(rank)
+
+    def format(self, record: logging.LogRecord) -> str:
+        rank = self._rank()
+        if self.json_mode:
+            out = {"ts": round(record.created, 3),
+                   "level": record.levelname,
+                   "logger": record.name,
+                   "rank": rank,
+                   "pid": record.process,
+                   "msg": record.getMessage()}
+            if record.exc_info:
+                out["exc"] = self.formatException(record.exc_info)
+            if record.stack_info:
+                out["stack"] = self.formatStack(record.stack_info)
+            return _json.dumps(out)
+        msg = (f"[{record.levelname} rla-tpu {rank}:{record.process}] "
+               f"{record.getMessage()}")
+        if record.exc_info:
+            msg = f"{msg}\n{self.formatException(record.exc_info)}"
+        if record.stack_info:
+            msg = f"{msg}\n{self.formatStack(record.stack_info)}"
+        return msg
+
+
 log = logging.getLogger("ray_lightning_accelerators_tpu")
-if not log.handlers:
-    _h = logging.StreamHandler()
-    _h.setFormatter(logging.Formatter("[%(levelname)s rla-tpu] %(message)s"))
-    log.addHandler(_h)
-    _level = knobs.get_str("RLA_TPU_LOG_LEVEL", "WARNING").upper()
-    if not isinstance(logging.getLevelName(_level), int):
-        # a typo'd level must not crash at import time (setLevel raises)
+
+
+def configure_logging(json_mode: Optional[bool] = None) -> None:
+    """(Re)install the package handler/formatter.  ``json_mode`` None
+    reads the ``RLA_TPU_LOG_JSON`` knob; runs once at import and again
+    whenever a caller (or test) flips the knob."""
+    if json_mode is None:
+        json_mode = knobs.get_bool("RLA_TPU_LOG_JSON", False)
+    handler = next((h for h in log.handlers
+                    if isinstance(h, logging.StreamHandler)), None)
+    if handler is None:
+        handler = logging.StreamHandler()
+        log.addHandler(handler)
+    handler.setFormatter(_RankFormatter(json_mode=json_mode))
+    level = knobs.get_str("RLA_TPU_LOG_LEVEL", "WARNING").upper()
+    if not isinstance(logging.getLevelName(level), int):
+        # a typo'd level must not crash at import/boot time
         log.setLevel("WARNING")
-        log.warning("bad RLA_TPU_LOG_LEVEL=%r; using WARNING", _level)
+        log.warning("bad RLA_TPU_LOG_LEVEL=%r; using WARNING", level)
     else:
-        log.setLevel(_level)
+        log.setLevel(level)
+
+
+if not log.handlers:
+    configure_logging()
 
 
 class Logger:

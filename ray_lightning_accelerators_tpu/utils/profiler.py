@@ -71,6 +71,42 @@ class _SpanStat:
             if j < cap:
                 self.samples[j] = dt
 
+    def merge(self, count: int, total: float, samples: List[float],
+              maxv: float, cap: int = 4096) -> None:
+        """Fold another stat's (count, total, reservoir, max) into this
+        one.  Count/total/max are exact.  The merged reservoir is a
+        near-uniform sample of the UNION of the two underlying
+        populations: when the combined sample fits the cap both sets are
+        kept whole; otherwise elements are kept by A-Res weighted
+        sampling, each sample weighted by how many real observations it
+        represents (``count / len(samples)`` on its side) — a reservoir
+        summarizing 10k spans must dominate one summarizing 10, or the
+        merged percentiles would skew toward the small rank."""
+        if count <= 0:
+            return
+        mine_n, mine = self.count, self.samples
+        self.count += int(count)
+        self.total += float(total)
+        if maxv > self.maxv:
+            self.maxv = float(maxv)
+        union = list(mine) + list(samples)
+        if len(union) <= cap:
+            self.samples = union
+            return
+        weighted = []
+        for src_samples, src_count in ((mine, mine_n), (samples, count)):
+            if not src_samples:
+                continue
+            w = max(1.0, src_count / len(src_samples))
+            weighted += [(s, w) for s in src_samples]
+        # A-Res: key = u^(1/w); the cap largest keys are a weighted
+        # sample without replacement.  Seeded rng: merges are
+        # deterministic for a given input order.
+        rng = random.Random(0xC0FFEE ^ self.count)
+        keyed = sorted(((rng.random() ** (1.0 / w), s)
+                        for s, w in weighted), reverse=True)
+        self.samples = [s for _k, s in keyed[:cap]]
+
 
 class Profiler:
     """Named nested wall-clock spans + XLA trace annotations."""
@@ -192,6 +228,67 @@ class Profiler:
         no compression-enabled trainer compiled against this profiler)."""
         with self._lock:
             return dict(self._comms) if self._comms is not None else None
+
+    # ------------------------------------------------------------------ #
+    # Cross-process merge (telemetry/registry.py)                         #
+    # ------------------------------------------------------------------ #
+    def export_state(self) -> Dict[str, Any]:
+        """A picklable/JSON-able snapshot of everything this profiler
+        accumulated — span stats WITH their raw reservoirs (percentile
+        merging needs samples, not quantiles), counters, gauges, and the
+        comms record.  The cross-rank telemetry gather ships this shape
+        home so the driver can ``merge()`` every rank into one report."""
+        with self._lock:
+            return {
+                "stats": {name: {"count": st.count, "total": st.total,
+                                 "samples": list(st.samples),
+                                 "max": st.maxv}
+                          for name, st in self._stats.items()},
+                "counters": dict(self._counters),
+                "gauges": {k: list(v) for k, v in self._gauges.items()},
+                "comms": (dict(self._comms) if self._comms is not None
+                          else None),
+            }
+
+    def merge(self, other: Any) -> "Profiler":
+        """Fold another profiler (or an ``export_state()`` dict from one)
+        into this one.  Span counts/totals/maxes are exact; reservoirs
+        merge count-weighted (see ``_SpanStat.merge``); counters sum;
+        gauges combine count/sum/min/max with the other side's ``last``
+        winning (merge order = recency order by convention); the comms
+        record is adopted when this profiler has none (it is analytic
+        and identical across SPMD ranks).  Returns self for chaining."""
+        state = other.export_state() if isinstance(other, Profiler) \
+            else other
+        if not isinstance(state, dict):
+            raise TypeError(
+                f"Profiler.merge takes a Profiler or export_state() "
+                f"dict, got {type(other).__name__}")
+        with self._lock:
+            for name, row in (state.get("stats") or {}).items():
+                st = self._stats.setdefault(name, _SpanStat())
+                st.merge(int(row.get("count", 0)),
+                         float(row.get("total", 0.0)),
+                         list(row.get("samples") or ()),
+                         float(row.get("max", 0.0)))
+            for name, n in (state.get("counters") or {}).items():
+                self._counters[name] = self._counters.get(name, 0) + int(n)
+            for name, g in (state.get("gauges") or {}).items():
+                c, s, lo, hi, last = g
+                mine = self._gauges.get(name)
+                if mine is None:
+                    self._gauges[name] = [int(c), float(s), float(lo),
+                                          float(hi), float(last)]
+                else:
+                    mine[0] += int(c)
+                    mine[1] += float(s)
+                    mine[2] = min(mine[2], float(lo))
+                    mine[3] = max(mine[3], float(hi))
+                    if c:
+                        mine[4] = float(last)
+            if self._comms is None and state.get("comms") is not None:
+                self._comms = dict(state["comms"])
+        return self
 
     # ------------------------------------------------------------------ #
     def summary(self) -> Dict[str, Dict[str, float]]:

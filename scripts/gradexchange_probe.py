@@ -101,6 +101,12 @@ def main() -> None:
     compile_rec = dict(cg.compile_count_record("gradexchange"),
                        measured_window_compiles=window_compiles[0])
     print(json.dumps(compile_rec), flush=True)
+    # unified telemetry snapshot (telemetry/registry.py): value-less and
+    # kind-tagged, printed before the metric so the newest value-bearing
+    # line stays the bench result either way
+    from ray_lightning_accelerators_tpu.telemetry import (
+        probe_snapshot_record)
+    print(json.dumps(probe_snapshot_record("gradexchange")), flush=True)
     print(json.dumps(record), flush=True)
 
 

@@ -182,6 +182,14 @@ def main() -> None:
     # stdout as the bench result.
     print(json.dumps(cg.compile_count_record("input_pipeline")),
           flush=True)
+    # unified telemetry snapshot (telemetry/registry.py): the prefetch
+    # run's profiler (h2d_wait span, starvation counter, depth gauge) +
+    # recorder events + compile count in one registry export — value-
+    # less and kind-tagged, so the metric line below stays the result
+    from ray_lightning_accelerators_tpu.telemetry import (
+        probe_snapshot_record)
+    print(json.dumps(probe_snapshot_record("input_pipeline",
+                                           profiler=prof)), flush=True)
     print(json.dumps(record), flush=True)
 
 

@@ -12,14 +12,21 @@ Usage::
 
     python scripts/serve_probe.py [--requests N] [--slots S] [--seed K]
 
-Output (metric line + compile-count line)::
+Output (compile-count line, telemetry line, metric line LAST)::
 
+    {"probe": "serve", "kind": "compile_count",
+     "total_backend_compiles": ..., "measured_window_compiles": 0}
+    {"probe": "serve", "kind": "telemetry", "snapshot": {...}, ...}
     {"probe": "serve", "requests": ..., "max_slots": ...,
      "throughput_tok_s": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
      "token_p50_ms": ..., "token_p99_ms": ..., "token_max_ms": ...,
      "steps": ..., "steps_batch_gt1": ..., "max_batch": ...}
-    {"probe": "serve", "kind": "compile_count",
-     "total_backend_compiles": ..., "measured_window_compiles": 0}
+
+The ``kind="telemetry"`` line is the unified MetricsRegistry export
+(telemetry/registry.py).  The serve metric record carries no ``value``
+key, so it is printed last: a bench-style newest-line-fallback parser
+(bench._last_metric_record) finds it by position, while the kind-tagged
+records never displace it.
 
 A nonzero ``measured_window_compiles`` means the engine retraced inside
 the measured window — the 3-program invariant broke (see
@@ -82,12 +89,21 @@ def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
             h.result(timeout=600)
         snap = engine.stats()
         compile_rec = cg.compile_count_record("serve", window_start)
+        # unified telemetry snapshot (telemetry/registry.py): serve
+        # counters/latency reservoirs + recorder event tallies + compile
+        # count in ONE registry export.  kind-tagged and value-less, so
+        # bench.py's newest-value-bearing-line parser still picks the
+        # metric record (tests/test_bench_probe.py pins this).
+        from ray_lightning_accelerators_tpu.telemetry import (
+            probe_snapshot_record)
+        telemetry_rec = probe_snapshot_record("serve",
+                                              serve=engine.metrics)
 
     def ms(fam, key):
         row = snap.get(fam) or {}
         return round(1e3 * row.get(key, 0.0), 3)
 
-    return compile_rec, {
+    return compile_rec, telemetry_rec, {
         "probe": "serve", "requests": n_requests, "max_slots": max_slots,
         "tokens_generated": snap["tokens_generated"],
         "busy_s": round(snap["busy_s"], 3),
@@ -105,18 +121,24 @@ def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
 
 
 def main() -> None:
-    compile_rec = None
+    compile_rec = telemetry_rec = None
     try:
-        compile_rec, rec = probe(_arg("--requests", 16), _arg("--slots", 4),
-                                 _arg("--seed", 0))
+        compile_rec, telemetry_rec, rec = probe(
+            _arg("--requests", 16), _arg("--slots", 4), _arg("--seed", 0))
     except Exception as e:
         rec = {"probe": "serve",
                "error": f"{type(e).__name__}: {e}"[:400]}
-    print(json.dumps(rec), flush=True)
     if compile_rec is not None:
         # a measured-window compile count > 0 means the decode loop
         # retraced mid-flight — visible here even when nothing asserts
         print(json.dumps(compile_rec), flush=True)
+    if telemetry_rec is not None:
+        print(json.dumps(telemetry_rec), flush=True)
+    # metric record LAST: the serve metric line carries no "value" key,
+    # so bench-style newest-line-fallback parsers must find it newest
+    # (the other probes' metric lines are value-bearing and win on key;
+    # this one wins on position)
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

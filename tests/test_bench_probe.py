@@ -292,3 +292,38 @@ def test_last_metric_record_skips_compile_count_lines():
     assert bench._last_metric_record(
         json.dumps(compile_rec))["kind"] == "compile_count"
     assert bench._last_metric_record("no json here") is None
+
+
+def test_last_metric_record_survives_telemetry_snapshot_line():
+    """Probes now end with a ``kind="telemetry"`` MetricsRegistry
+    snapshot (PR 7).  It is value-less by contract, so the newest
+    VALUE-BEARING line — the real metric — still wins the parse in
+    either print order (the PR 6 contract, re-pinned against the new
+    line)."""
+    metric = {"metric": "serve_throughput", "value": 120.5, "unit":
+              "tok/s", "vs_baseline": 1.1}
+    compile_rec = {"probe": "serve", "kind": "compile_count",
+                   "total_backend_compiles": 9}
+    telemetry_rec = {"probe": "serve", "kind": "telemetry",
+                     "snapshot": {"spans": {}, "counters": {"x": 1},
+                                  "compile": {"total_backend_compiles": 9}}}
+    # value-bearing metric: it wins regardless of print order
+    out = "\n".join(json.dumps(r) for r in
+                    (metric, compile_rec, telemetry_rec))
+    assert bench._last_metric_record(out) == metric
+    # gradexchange-style order: bookkeeping first, metric last
+    out = "\n".join(json.dumps(r) for r in
+                    (compile_rec, telemetry_rec, metric))
+    assert bench._last_metric_record(out) == metric
+    # the REAL serve metric record has no "value" key — it only wins by
+    # POSITION, which is why serve_probe prints it last (pinned here
+    # with the actual record shape, not a value-bearing stand-in)
+    serve_metric = {"probe": "serve", "requests": 16,
+                    "throughput_tok_s": 120.5, "steps": 40}
+    out = "\n".join(json.dumps(r) for r in
+                    (compile_rec, telemetry_rec, serve_metric))
+    assert bench._last_metric_record(out) == serve_metric
+    # a window that died before the metric: the telemetry record may be
+    # the fallback surfaced, never mistaken for a value
+    rec = bench._last_metric_record(json.dumps(telemetry_rec))
+    assert rec["kind"] == "telemetry" and "value" not in rec
