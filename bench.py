@@ -537,57 +537,56 @@ def _last_metric_record(stdout: str):
     return fallback
 
 
-def bench_gradexchange() -> dict:
-    """Gradient-exchange microbench (fp32 implicit-psum vs int8/bf16
-    quantized allreduce, parallel/collectives.py): step time + bytes
-    moved on a forced-host-platform 8-device CPU mesh.
-
-    Always measured in a FRESH subprocess running
-    ``scripts/gradexchange_probe.py``, which forces ``JAX_PLATFORMS=cpu``
-    before backend init -- so this bench produces a real number even on
-    a machine whose accelerator backend is dead (it is the probe-failure
-    fallback in ``main``), and never touches a possibly-wedged tunnel."""
+def _run_cpu_probe(script_name: str, label: str) -> dict:
+    """Run one of the forced-host-platform CPU-mesh probe scripts in a
+    FRESH subprocess and return its newest value-bearing JSON line.  The
+    probes force ``JAX_PLATFORMS=cpu`` before backend init, so they
+    produce a real number even on a machine whose accelerator backend is
+    dead — which is why these benches double as the probe-failure
+    fallback set in ``main`` and never touch a possibly-wedged tunnel."""
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "gradexchange_probe.py")
+                          "scripts", script_name)
     proc = subprocess.run([sys.executable, script], capture_output=True,
                           text=True, timeout=600)
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
         raise RuntimeError(
-            f"gradexchange probe failed (rc {proc.returncode}): "
+            f"{label} probe failed (rc {proc.returncode}): "
             + " | ".join(tail))
     rec = _last_metric_record(proc.stdout)
     if rec is None:
-        raise RuntimeError("gradexchange probe produced no JSON record")
+        raise RuntimeError(f"{label} probe produced no JSON record")
     return rec
+
+
+def bench_gradexchange() -> dict:
+    """Gradient-exchange microbench (fp32 implicit-psum vs int8/bf16
+    quantized allreduce, parallel/collectives.py): step time + bytes
+    moved on a forced-host-platform 8-device CPU mesh (see
+    ``_run_cpu_probe``)."""
+    return _run_cpu_probe("gradexchange_probe.py", "gradexchange")
 
 
 def bench_input_pipeline() -> dict:
     """Async-input-pipeline bench (prefetch_batches=2 vs 0 steps/s on a
-    synthetic input-bound loader, data/prefetch.py): measured in a FRESH
-    subprocess running ``scripts/input_pipeline_probe.py``, which forces
-    an 8-device host-platform CPU mesh before backend init — so, like
-    ``gradexchange``, it produces a real metric even on a machine whose
-    accelerator backend is dead, and is part of the probe-failure
-    fallback set in ``main``."""
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "input_pipeline_probe.py")
-    proc = subprocess.run([sys.executable, script], capture_output=True,
-                          text=True, timeout=600)
-    if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
-        raise RuntimeError(
-            f"input_pipeline probe failed (rc {proc.returncode}): "
-            + " | ".join(tail))
-    rec = _last_metric_record(proc.stdout)
-    if rec is None:
-        raise RuntimeError("input_pipeline probe produced no JSON record")
-    return rec
+    synthetic input-bound loader, data/prefetch.py): see
+    ``_run_cpu_probe``."""
+    return _run_cpu_probe("input_pipeline_probe.py", "input_pipeline")
+
+
+def bench_fsdp_exchange() -> dict:
+    """Compressed-FSDP exchange bench (int8 reduce-scatter into the shard
+    owner + bf16 param all-gather vs fp32, parallel/collectives.py):
+    wire-bytes ratio + measured per-shard peak state bytes vs a
+    replicated layout, on a forced-host-platform 8-device CPU mesh (see
+    ``_run_cpu_probe``)."""
+    return _run_cpu_probe("fsdp_exchange_probe.py", "fsdp_exchange")
 
 
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
-           "input_pipeline": bench_input_pipeline}
+           "input_pipeline": bench_input_pipeline,
+           "fsdp_exchange": bench_fsdp_exchange}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -610,7 +609,8 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 # benches that run on a forced host-platform CPU mesh in their own
 # subprocess: they cannot be taken down by a dead accelerator backend,
 # so they double as the probe-failure fallback set
-_CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline")
+_CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
+                         "fsdp_exchange")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -623,7 +623,8 @@ def _emit_cpu_fallbacks(done=()) -> int:
     fallback failure must never mask the death record."""
     emitted = len(tuple(done))
     fallbacks = {"gradexchange": lambda: bench_gradexchange(),
-                 "input_pipeline": lambda: bench_input_pipeline()}
+                 "input_pipeline": lambda: bench_input_pipeline(),
+                 "fsdp_exchange": lambda: bench_fsdp_exchange()}
     for name in _CPU_FALLBACK_BENCHES:
         if name in done:
             continue
@@ -711,7 +712,8 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--benches",
-        default="mnist,gpt,cifar,decode,gradexchange,input_pipeline",
+        default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
+                "fsdp_exchange",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--probe-timeout", type=float,
                         default=float(os.environ.get(

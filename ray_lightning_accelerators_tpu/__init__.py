@@ -18,6 +18,7 @@ from .data.loader import (ArrayDataset, DataLoader, Dataset,
                           IterableDataset, RandomDataset, ShardedSampler)
 from .data.prefetch import (DevicePrefetcher, PrefetchIterator,
                             prefetch_pipeline)
+from .parallel.collectives import TensorShardedParamsError
 from .parallel.mesh import MeshConfig, build_mesh
 from .runtime.elastic import ElasticResizeError, ElasticRunner
 from .runtime.preemption import Preempted, PreemptionNotice, get_notice
@@ -43,7 +44,7 @@ __all__ = [
     "RandomDataset", "ShardedSampler",
     "PrefetchIterator", "DevicePrefetcher", "prefetch_pipeline",
     "MeshConfig", "build_mesh",
-    "ElasticRunner", "ElasticResizeError",
+    "ElasticRunner", "ElasticResizeError", "TensorShardedParamsError",
     "Preempted", "PreemptionNotice", "get_notice",
     "get_actor_rank", "init_session", "put_queue",
     "Profiler", "device_memory_stats",

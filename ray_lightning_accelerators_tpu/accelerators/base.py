@@ -44,6 +44,10 @@ class Accelerator:
         # build_hybrid_mesh); 1 x 1 = single slice
         self.dcn_data = dcn_data
         self.dcn_pipeline = dcn_pipeline
+        # large leaves infer_fsdp_shardings had to warn-and-replicate in
+        # the last param_shardings resolution (observability: telemetry
+        # event `fsdp_fallback` + trainer-side profiler counter)
+        self.last_fsdp_fallbacks: list = []
         self._mesh: Optional[Mesh] = None
 
     # ---------------------------------------------------------------- #
@@ -104,26 +108,63 @@ class Accelerator:
     def batch_sharding(self, mesh: Mesh) -> NamedSharding:
         return mesh_lib.batch_sharding(mesh)
 
-    def state_shardings(self, mesh: Mesh, state: Any, module: Any = None,
-                        tx: Any = None) -> Any:
-        """Sharding pytree for the TrainState.
+    def param_shardings(self, mesh: Mesh, params: Any, module: Any = None,
+                        report_fallbacks: bool = True) -> Any:
+        """Param half of ``state_shardings`` (factored out so the trainer
+        can resolve the compressed-exchange layout BEFORE building
+        residual state).
 
-        Priority: a module exposing ``param_logical_axes()`` gets rule-based
-        shardings (tp/fsdp/sp-aware); otherwise ``use_fsdp`` shards large
-        leaves over the fsdp axis; otherwise everything replicates (pure DP).
-        Optimizer moments inherit each param's layout via
-        ``optax.tree_map_params``.
-        """
+        Priority: a module exposing ``param_logical_axes()`` gets
+        rule-based shardings (tp/fsdp/sp-aware); otherwise ``use_fsdp``
+        shards large leaves over the fsdp axis; otherwise everything
+        replicates (pure DP).  When ``infer_fsdp_shardings`` has to
+        warn-and-replicate a large leaf (no fsdp-divisible dim), each
+        fallback lands in ``last_fsdp_fallbacks`` and — unless
+        ``report_fallbacks=False`` (probe calls) — emits a telemetry
+        event (kind ``fsdp_fallback``) so the silent loss of FSDP
+        memory savings shows up in the unified MetricsRegistry export."""
+        repl = NamedSharding(mesh, P())
+        if report_fallbacks:
+            # every REPORTING resolution re-records its fallbacks, so a
+            # later fit on this accelerator never mirrors a previous
+            # run's count into the profiler
+            self.last_fsdp_fallbacks = []
+        if module is not None and hasattr(module, "param_logical_axes"):
+            return sharding_lib.tree_logical_to_shardings(
+                mesh, module.param_logical_axes())
+        if self.use_fsdp:
+            fallbacks = []
+
+            def on_fallback(name, leaf):
+                fallbacks.append({"param": name,
+                                  "shape": list(map(int, leaf.shape))})
+
+            sh = sharding_lib.infer_fsdp_shardings(
+                params, mesh, on_fallback=on_fallback)
+            if report_fallbacks:
+                from ..telemetry import recorder as telemetry
+                for fb in fallbacks:
+                    log.warning(
+                        "use_fsdp: param %s %s has no dim divisible by "
+                        "the fsdp axis; it (and its optimizer moments) "
+                        "stay REPLICATED — no FSDP memory saving for "
+                        "this leaf", fb["param"], tuple(fb["shape"]))
+                    telemetry.emit("fsdp_fallback", **fb)
+                self.last_fsdp_fallbacks = fallbacks
+            return sh
+        return jax.tree.map(lambda _: repl, params)
+
+    def state_shardings(self, mesh: Mesh, state: Any, module: Any = None,
+                        tx: Any = None,
+                        report_fallbacks: bool = True) -> Any:
+        """Sharding pytree for the TrainState (see ``param_shardings``
+        for the param layout rules).  Optimizer moments inherit each
+        param's layout via ``optax.tree_map_params``."""
         import optax as _optax
 
         repl = NamedSharding(mesh, P())
-        if module is not None and hasattr(module, "param_logical_axes"):
-            param_sh = sharding_lib.tree_logical_to_shardings(
-                mesh, module.param_logical_axes())
-        elif self.use_fsdp:
-            param_sh = sharding_lib.infer_fsdp_shardings(state.params, mesh)
-        else:
-            param_sh = jax.tree.map(lambda _: repl, state.params)
+        param_sh = self.param_shardings(mesh, state.params, module=module,
+                                        report_fallbacks=report_fallbacks)
 
         params_sharded = any(
             not s.is_fully_replicated for s in jax.tree.leaves(param_sh))
@@ -140,19 +181,45 @@ class Accelerator:
                         "state (%s: %s); optimizer moments will be fully "
                         "REPLICATED -- expect ~3x param memory per device, "
                         "defeating FSDP savings", type(e).__name__, e)
+                    if report_fallbacks:
+                        fb = {"param": "<opt_state>",
+                              "reason": f"{type(e).__name__}: {e}"}
+                        # keep the profiler counter (fed from
+                        # last_fsdp_fallbacks) in lockstep with the
+                        # event tally
+                        self.last_fsdp_fallbacks.append(fb)
+                        from ..telemetry import recorder as telemetry
+                        telemetry.emit("fsdp_fallback", **fb)
         else:
             opt_sh = jax.tree.map(lambda _: repl, state.opt_state)
             if params_sharded:
                 log.warning("state_shardings called without tx; optimizer "
                             "moments will be fully replicated")
-        # gradient-compression state (parallel/collectives.py): stacked
-        # per-replica trees, dim 0 over the batch axes; None when unused
-        from ..parallel import collectives as collectives_lib
+        # gradient-compression state (parallel/collectives.py): residual
+        # trees are ALWAYS stacked per-replica ([n, ...], dim 0 over the
+        # batch axes — both the DP and the shard-local FSDP layouts
+        # carry the replica dim, and the exchange's in_specs expect it);
+        # grad_accum is stacked under pure DP ([n, *param] — one more
+        # dim than its param, so the shape test below cannot collide)
+        # but PARAM-shaped (post-exchange, shard-local) under compressed
+        # FSDP, where it inherits the param layout
+        stacked = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+
+        def accum_sh(tree):
+            if tree is None:
+                return None
+            return jax.tree.map(
+                lambda leaf, p, p_sh: (
+                    p_sh if tuple(getattr(leaf, "shape", ()))
+                    == tuple(getattr(p, "shape", ())) else stacked),
+                tree, state.params, param_sh)
+
         extras = {
-            field: (None if getattr(state, field, None) is None
-                    else collectives_lib.stacked_shardings(
-                        mesh, getattr(state, field)))
-            for field in ("residual", "grad_accum")}
+            "residual": (None if getattr(state, "residual", None) is None
+                         else jax.tree.map(lambda _: stacked,
+                                           state.residual)),
+            "grad_accum": accum_sh(getattr(state, "grad_accum", None)),
+        }
         return state.replace(step=repl, params=param_sh, opt_state=opt_sh,
                              rng=repl, **extras)
 

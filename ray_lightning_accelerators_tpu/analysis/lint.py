@@ -344,6 +344,11 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     # the flight recorder's emit runs inside every other hot root: it
     # must never host-sync or allocate unboundedly (telemetry/)
     "telemetry/recorder.py": ("FlightRecorder.emit",),
+    # the compressed-FSDP exchange + param gather are compiled INTO the
+    # train step: their builders (and shard_map bodies) must stay
+    # host-sync-free and build no jits in loops
+    "parallel/collectives.py": ("build_fsdp_exchange",
+                                "build_param_gather"),
 }
 
 # modules whose code runs inside dispatched workers: typed exceptions

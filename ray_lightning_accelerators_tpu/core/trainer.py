@@ -237,6 +237,9 @@ class Trainer:
         self._train_step_cached_fn = None
         self._epoch_scan_fn = None
         self._zero1_update_sh = None
+        # param shardings when the compressed exchange runs in the FSDP
+        # (reduce-scatter/all-gather) regime; None = replicated-DP regime
+        self._fsdp_param_sh = None
         # persistent fan-out world (spawned agent workers + formed
         # jax.distributed world), reused across entry points; see
         # _acquire_world / shutdown_workers
@@ -276,12 +279,27 @@ class Trainer:
         # redistribute via global shapes; per-replica residuals reset)
         world = {"dp": (mesh_lib.data_parallel_size(self._mesh)
                         if self._mesh is not None else None),
+                 "fsdp": (mesh_lib.mesh_axis_size(self._mesh,
+                                                  mesh_lib.FSDP_AXIS)
+                          if self._mesh is not None else None),
                  "processes": jax.process_count()}
+        extra = {"world": world}
+        # compressed-exchange buffer shapes (world-dependent: stacked
+        # replica dim / fsdp chunk sizes): lets a resumed run at a
+        # DIFFERENT world size rebuild an exactly-shaped restore template
+        # without re-deriving the saving mesh's layout heuristics
+        if self._state is not None:
+            for field in ("residual", "grad_accum"):
+                tree = getattr(self._state, field, None)
+                if tree is not None:
+                    extra[f"{field}_leaf_shapes"] = [
+                        list(map(int, leaf.shape))
+                        for leaf in jax.tree.leaves(tree)]
         payload = ckpt_lib.build_checkpoint(
             self._state if include_state else None,
             self.epochs_completed, self.global_step,
             hparams=getattr(self.module, "hparams", {}), callbacks=cb_states,
-            extra={"world": world})
+            extra=extra)
         if self.module is not None:
             self.module.on_save_checkpoint(payload)
         for c in self.callbacks:
@@ -404,30 +422,54 @@ class Trainer:
         array shapes are world-independent — only per-replica state
         (error-feedback residuals, local-grad accumulators) and the
         shard LAYOUT change, and the layout re-resolves from the current
-        mesh in ``_compile``."""
-        saved_dp = (payload.get("world") or {}).get("dp")
+        mesh in ``_compile``.  A dp-preserving mesh RE-SPLIT (data=1 x
+        fsdp=8 -> data=2 x fsdp=4) counts too: the shard-local FSDP
+        residual chunk sizes depend on the fsdp extent, so the run's own
+        buffers cannot serve as the restore template."""
+        world = payload.get("world") or {}
+        saved_dp = world.get("dp")
+        saved_fsdp = world.get("fsdp")
         cur_dp = mesh_lib.data_parallel_size(self._mesh)
-        if saved_dp is None or saved_dp == cur_dp:
+        cur_fsdp = mesh_lib.mesh_axis_size(self._mesh, mesh_lib.FSDP_AXIS)
+        if saved_dp is None or (saved_dp == cur_dp and
+                                saved_fsdp in (None, cur_fsdp)):
             return None
         log.warning(
             "resuming a checkpoint saved at data-parallel world size %d "
-            "onto %d: ZeRO-1/optimizer shards redistribute via their "
-            "global shapes; per-replica error-feedback residuals and "
-            "gradient accumulators reset to zero (replica-local "
-            "semantics cannot cross world sizes)", saved_dp, cur_dp)
+            "(fsdp %s) onto %d (fsdp %d): ZeRO-1/optimizer shards "
+            "redistribute via their global shapes; per-replica "
+            "error-feedback residuals and gradient accumulators reset "
+            "to zero (replica-local semantics cannot cross world "
+            "layouts)", saved_dp, saved_fsdp, cur_dp, cur_fsdp)
         return (saved_dp, cur_dp)
 
     def _restore_sharded_state(self, ckpt_path: str, state: TrainState,
-                               resized: Optional[tuple]) -> TrainState:
+                               resized: Optional[tuple],
+                               payload: Optional[Dict[str, Any]] = None
+                               ) -> TrainState:
         """Orbax restore with template reconciliation.  Candidate
         templates, in order: the run's own state (skipped on a world
         resize — its per-replica buffers have the wrong leading dim);
         stripped of residual/grad_accum (checkpoint predates them, or
         carries none); carrying SAVED-world-shaped buffers (compression
         checkpoint restored onto a different world — restored buffers
-        are then discarded for this run's fresh zeros)."""
+        are then discarded for this run's fresh zeros).  Saved-world
+        buffer shapes come from the shape record in ``meta.json`` when
+        present (exact for the shard-local FSDP layout, whose chunk
+        sizes depend on the saved fsdp size), else re-derived as the
+        stacked-DP layout from ``saved_dp``."""
         from ..parallel import collectives as collectives_lib
         from ..utils import sharded_checkpoint as sharded_lib
+
+        payload = payload or {}
+
+        def recorded_tree(field):
+            shapes = payload.get(f"{field}_leaf_shapes")
+            flat, treedef = jax.tree.flatten(state.params)
+            if not isinstance(shapes, list) or len(shapes) != len(flat):
+                return None
+            return treedef.unflatten(
+                [jnp.zeros(tuple(s), jnp.float32) for s in shapes])
 
         carries = (state.residual is not None
                    or state.grad_accum is not None)
@@ -440,11 +482,20 @@ class Trainer:
                  state.replace(residual=None, grad_accum=None)))
             if resized:
                 saved_dp = resized[0]
-                res = (None if state.residual is None else
-                       collectives_lib.residual_zeros(
-                           state.params, saved_dp, self._exchange_cfg))
-                acc = (None if state.grad_accum is None else
-                       collectives_lib.accum_zeros(state.params, saved_dp))
+                # explicit None tests: recorded_tree returns a bare
+                # array for single-leaf param trees, whose truthiness
+                # raises
+                res = acc = None
+                if state.residual is not None:
+                    res = recorded_tree("residual")
+                    if res is None:
+                        res = collectives_lib.residual_zeros(
+                            state.params, saved_dp, self._exchange_cfg)
+                if state.grad_accum is not None:
+                    acc = recorded_tree("grad_accum")
+                    if acc is None:
+                        acc = collectives_lib.accum_zeros(state.params,
+                                                          saved_dp)
                 candidates.append(
                     ("saved-world",
                      state.replace(residual=res, grad_accum=acc)))
@@ -458,8 +509,8 @@ class Trainer:
                 # and the saved shards redistribute onto the new world —
                 # never materializing through the SAVED mesh, whose
                 # devices may no longer exist
-                shardings = self._resolve_state_shardings(self.module,
-                                                          template)
+                shardings = self._resolve_state_shardings(
+                    self.module, template, report_fallbacks=False)
                 if template.residual is not None \
                         or template.grad_accum is not None:
                     # saved-world-shaped buffers are discarded right
@@ -503,7 +554,8 @@ class Trainer:
             payload = sharded_lib.read_metadata(ckpt_path)
             resized = self._detect_resize(payload)
             self._resumed_world_resize = resized
-            state = self._restore_sharded_state(ckpt_path, state, resized)
+            state = self._restore_sharded_state(ckpt_path, state, resized,
+                                                payload=payload)
         else:
             payload = ckpt_lib.read_checkpoint(ckpt_path)
             resized = self._detect_resize(payload)
@@ -552,30 +604,36 @@ class Trainer:
         return tx
 
     def _resolve_state_shardings(self, module: TpuModule,
-                                 state: TrainState):
+                                 state: TrainState,
+                                 report_fallbacks: bool = True):
         """State shardings for THIS run's mesh (accelerator layout +
         ZeRO-1 re-sharding when enabled); sets ``_zero1_update_sh`` as a
-        side effect.  Shared by ``_compile`` and the sharded restore
-        path — an elastic resume re-resolves the layout against the NEW
-        (possibly smaller) mesh and restores straight into it."""
+        side effect.  Shared by ``_compile`` (the authoritative
+        resolution — the one that reports fsdp_fallback telemetry) and
+        the sharded restore path — an elastic resume re-resolves the
+        layout against the NEW (possibly smaller) mesh, once per
+        candidate template, and restores straight into it
+        (``report_fallbacks=False`` there so one fallback leaf does not
+        emit one event per template)."""
         from ..parallel import collectives as collectives_lib
 
         mesh = self._mesh
-        state_sh = self.accelerator.state_shardings(mesh, state,
-                                                    module=module,
-                                                    tx=self._tx)
+        state_sh = self.accelerator.state_shardings(
+            mesh, state, module=module, tx=self._tx,
+            report_fallbacks=report_fallbacks)
         params_replicated = all(
             s.is_fully_replicated for s in jax.tree.leaves(state_sh.params))
+        self._fsdp_param_sh = None
         if self.grad_compression is not None and not params_replicated:
-            # the compressed exchange shard_maps with in_specs=P() -- it
-            # would all-gather FSDP/TP-sharded params into every replica
-            # each step and allocate full-size residual buffers, silently
-            # destroying the memory savings the sharding exists for
-            raise ValueError(
-                "grad_compression requires replicated params (pure data "
-                "parallelism), but this module/accelerator shards them "
-                "(use_fsdp / param_logical_axes).  Drop grad_compression "
-                "or the parameter sharding.")
+            # compressed FSDP: fsdp-sharded params ride the quantized
+            # reduce-scatter-into-owner exchange (ZeRO-2/3,
+            # collectives.build_fsdp_exchange); any model-parallel
+            # (tensor/sequence/pipeline) sharding refuses typed — those
+            # gradients are not replicas over the batch axes, so a
+            # quantized replica exchange of them would be silently wrong
+            for s in jax.tree.leaves(state_sh.params):
+                collectives_lib.fsdp_shard_dim(s)  # raises typed on TP
+            self._fsdp_param_sh = state_sh.params
         self._zero1_update_sh = None
         if self.shard_optimizer_state:
             if not params_replicated:
@@ -602,6 +660,15 @@ class Trainer:
         state_sh = self._resolve_state_shardings(module, state)
         from ..parallel.sharding import validate_shardings
         validate_shardings(state.params, state_sh.params, mesh)
+        if self.profiler is not None:
+            # silent loss of FSDP savings, counted: leaves the accelerator
+            # had to warn-and-replicate (telemetry event `fsdp_fallback`
+            # fires at resolution; this mirrors it into the merged
+            # MetricsRegistry counter export)
+            n_fb = len(getattr(self.accelerator,
+                               "last_fsdp_fallbacks", ()) or ())
+            if n_fb:
+                self.profiler.incr("fsdp_fallback", n_fb)
         tx = self._tx
 
         # batch_sh / repl act as pytree *prefixes*: one sharding covers
@@ -690,10 +757,11 @@ class Trainer:
 
         if self.grad_compression is not None:
             # the collective payloads of a compiled step are static, so
-            # the bytes-on-wire claim is computed, not sampled
+            # the bytes-on-wire claim is computed, not sampled (FSDP
+            # regime: reduce-scatter + bf16 param all-gather accounting)
             report = collectives_lib.wire_bytes_per_step(
                 state.params, collectives_lib.dp_size(mesh),
-                self._exchange_cfg)
+                self._exchange_cfg, param_shardings=self._fsdp_param_sh)
             self.comms_per_step = report
             if self.profiler is not None:
                 self.profiler.record_comms(report)
@@ -732,6 +800,9 @@ class Trainer:
 
         local_grad_fn = collectives_lib.build_local_grads(
             mesh, vag, batch_sh.spec, extra_metrics=extra)
+        if self._fsdp_param_sh is not None:
+            return self._build_fsdp_train_step(
+                mesh, cfg, k, local_grad_fn, apply_grads, step_metrics_lr)
         exchange_fn = collectives_lib.build_exchange(mesh, cfg)
 
         def train_step(st: TrainState, batch):
@@ -768,6 +839,70 @@ class Trainer:
             new_params, new_opt, new_res, new_acc = jax.lax.cond(
                 boundary, at_boundary, off_boundary,
                 (acc, st.residual, st.opt_state, st.params))
+            new_state = st.replace(step=st.step + 1, params=new_params,
+                                   opt_state=new_opt, residual=new_res,
+                                   grad_accum=new_acc)
+            return new_state, step_metrics_lr(st, metrics)
+
+        return train_step
+
+    def _build_fsdp_train_step(self, mesh, cfg, k, local_grad_fn,
+                               apply_grads, step_metrics_lr):
+        """The compressed-FSDP (ZeRO-2/3) train step: params live SHARDED
+        over the fsdp axis (with their optimizer state — 1/N each), the
+        compute view is a bf16 all-gather
+        (``collectives.build_param_gather``), per-replica grads
+        reduce-scatter quantized INTO the shard owner
+        (``collectives.build_fsdp_exchange``, shard-local error-feedback
+        residuals), and the optimizer update runs shard-local — XLA
+        partitions the elementwise update from the matching layouts.
+
+        ``accumulate_grad_batches > 1`` accumulates the POST-exchange
+        owned shards in ``TrainState.grad_accum`` (param-shaped, so the
+        accumulator is 1/N per device too — the ZeRO-2 trade: the
+        reduce-scatter runs every micro-step instead of once per window,
+        but no full-size buffer ever exists) and gates only the
+        optimizer update on the window boundary."""
+        from ..parallel import collectives as collectives_lib
+
+        gather_fn = collectives_lib.build_param_gather(
+            mesh, self._fsdp_param_sh)
+        exchange_fn = collectives_lib.build_fsdp_exchange(
+            mesh, cfg, self._fsdp_param_sh)
+
+        def train_step(st: TrainState, batch):
+            step_rng = jax.random.fold_in(st.rng, st.step)
+            compute_params = gather_fn(st.params)
+            metrics, local = local_grad_fn(compute_params, batch, step_rng)
+            gshard, new_res = exchange_fn(local, st.residual)
+            if k == 1:
+                new_params, new_opt = apply_grads(gshard, st.opt_state,
+                                                  st.params)
+                new_state = st.replace(step=st.step + 1, params=new_params,
+                                       opt_state=new_opt, residual=new_res)
+                return new_state, step_metrics_lr(st, metrics)
+
+            acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                               st.grad_accum, gshard)
+            boundary = (st.step % k) == (k - 1)
+
+            def at_boundary(args):
+                acc, opt, params = args
+                # match MultiSteps: the applied gradient is the window
+                # MEAN of the (already-exchanged) per-micro-step shards
+                grads = jax.tree.map(lambda a, p: (a / k).astype(p.dtype),
+                                     acc, params)
+                new_params, new_opt = apply_grads(grads, opt, params)
+                return (new_params, new_opt,
+                        jax.tree.map(jnp.zeros_like, acc))
+
+            def off_boundary(args):
+                acc, opt, params = args
+                return params, opt, acc
+
+            new_params, new_opt, new_acc = jax.lax.cond(
+                boundary, at_boundary, off_boundary,
+                (acc, st.opt_state, st.params))
             new_state = st.replace(step=st.step + 1, params=new_params,
                                    opt_state=new_opt, residual=new_res,
                                    grad_accum=new_acc)
@@ -1433,11 +1568,34 @@ class Trainer:
         if self.grad_compression is not None:
             from ..parallel import collectives as collectives_lib
             n_dp = mesh_lib.data_parallel_size(self._mesh)
-            state = state.replace(
-                residual=collectives_lib.residual_zeros(
-                    init_params, n_dp, self._exchange_cfg),
-                grad_accum=(collectives_lib.accum_zeros(init_params, n_dp)
-                            if self.accumulate_grad_batches > 1 else None))
+            # the exchange regime decides the buffer shapes, so the param
+            # layout is probed BEFORE the residual state exists (quiet:
+            # _compile's authoritative resolution emits the fallback
+            # telemetry once); fsdp-sharded params get shard-local (1/N)
+            # residuals and param-shaped (post-exchange) accumulators —
+            # model-parallel shardings refuse typed right here
+            param_sh = self.accelerator.param_shardings(
+                self._mesh, init_params, module=module,
+                report_fallbacks=False)
+            fsdp_mode = any(
+                collectives_lib.fsdp_shard_dim(s) is not None
+                for s in jax.tree.leaves(param_sh))
+            if fsdp_mode:
+                state = state.replace(
+                    residual=collectives_lib.fsdp_residual_zeros(
+                        init_params, param_sh, self._exchange_cfg),
+                    grad_accum=(jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32),
+                        init_params)
+                        if self.accumulate_grad_batches > 1 else None))
+            else:
+                state = state.replace(
+                    residual=collectives_lib.residual_zeros(
+                        init_params, n_dp, self._exchange_cfg),
+                    grad_accum=(collectives_lib.accum_zeros(init_params,
+                                                            n_dp)
+                                if self.accumulate_grad_batches > 1
+                                else None))
         for c in self.callbacks:
             c.setup(self, module, "fit")
         if ckpt_path == "last":
@@ -2044,6 +2202,7 @@ class Trainer:
         self._idx_row_sharding = None
         self._idx_mat_sharding = None
         self._zero1_update_sh = None
+        self._fsdp_param_sh = None
         self.accelerator.teardown()
 
 

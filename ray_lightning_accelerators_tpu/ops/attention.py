@@ -32,6 +32,20 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..analysis import knobs
 
+# jax 0.4.x names it TPUCompilerParams; 0.5+ renamed to CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+
+def _compiler_params(**kwargs):
+    if _CompilerParams is None:  # neither name: unknown pallas build
+        raise RuntimeError(
+            "this jax build's pallas TPU module exposes neither "
+            "CompilerParams (jax>=0.5) nor TPUCompilerParams (jax 0.4.x);"
+            " flash attention cannot configure its kernels — pin a "
+            "supported jax or call attention_reference directly")
+    return _CompilerParams(**kwargs)
+
 _NEG_INF = -1e30
 
 
@@ -165,7 +179,7 @@ def _flash_forward(q3: jax.Array, k3: jax.Array, v3: jax.Array, scale: float,
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             # bh and q blocks are independent; only the kv walk carries
             # the online-softmax state
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -330,7 +344,7 @@ def _flash_backward_fused(q3, k3, v3, g3, lse, delta, scale, causal,
                    jax.ShapeDtypeStruct((bh, k_len, d), v3.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             # the q walk carries the dk/dv accumulators
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
@@ -361,7 +375,7 @@ def _flash_backward(q3, k3, v3, o3, lse, g3, scale, causal, block_q,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3, g3, lse, delta)
@@ -378,7 +392,7 @@ def _flash_backward(q3, k3, v3, o3, lse, g3, scale, causal, block_q,
                    jax.ShapeDtypeStruct((bh, k_len, d), v3.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3, g3, lse, delta)

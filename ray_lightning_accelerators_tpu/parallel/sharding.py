@@ -120,16 +120,22 @@ def replicate_tree(tree, mesh: Mesh):
     return jax.device_put(tree, sharding)
 
 
-def infer_fsdp_shardings(params, mesh: Mesh, min_size: int = 2 ** 12):
+def infer_fsdp_shardings(params, mesh: Mesh, min_size: int = 2 ** 12,
+                         on_fallback=None):
     """Heuristic FSDP sharding for models without logical annotations.
 
     Shards the largest dimension of each sufficiently-large leaf over the
     `fsdp` axis when divisible; small leaves stay replicated.  This gives
     user models ZeRO-style memory scaling with zero annotation work.
+
+    ``on_fallback(name, leaf)`` fires for each leaf LARGE enough to want
+    sharding whose dims all fail to divide the fsdp axis — the silent
+    loss-of-FSDP-savings case observability wants surfaced (the
+    accelerator routes it into a telemetry event + profiler counter).
     """
     fsdp = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
 
-    def one(leaf):
+    def one(path, leaf):
         if fsdp == 1 or not hasattr(leaf, "shape") or leaf.size < min_size:
             return NamedSharding(mesh, P())
         # pick the largest divisible dim
@@ -139,6 +145,8 @@ def infer_fsdp_shardings(params, mesh: Mesh, min_size: int = 2 ** 12):
                 spec = [None] * leaf.ndim
                 spec[d] = mesh_lib.FSDP_AXIS
                 return NamedSharding(mesh, P(*spec))
+        if on_fallback is not None:
+            on_fallback(jax.tree_util.keystr(path), leaf)
         return NamedSharding(mesh, P())
 
-    return jax.tree.map(one, params)
+    return jax.tree_util.tree_map_with_path(one, params)

@@ -75,6 +75,10 @@ EVENT_KINDS = frozenset({
     "preempt_drain", "emergency_checkpoint",
     # input pipeline (data/prefetch.py)
     "prefetch_starved",
+    # sharding resolution (accelerators/base.py): a large param leaf (or
+    # the optimizer-state mapping) fell back to REPLICATED under
+    # use_fsdp — silent loss of FSDP memory savings, surfaced
+    "fsdp_fallback",
     # worker dispatch loop (runtime/actors.py)
     "dispatch_begin", "dispatch_end",
     # supervision / retry layers (runtime/watchdog.py, runtime/elastic.py)

@@ -76,20 +76,25 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_input_pipeline",
         lambda: {"metric": "input_pipeline_prefetch_speedup",
                  "value": 1.8, "unit": "x", "vs_baseline": 1.2})
+    monkeypatch.setattr(
+        bench, "bench_fsdp_exchange",
+        lambda: {"metric": "fsdp_exchange_int8_wire_bytes_reduction",
+                 "value": 2.65, "unit": "x", "vs_baseline": 1.0})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 3
+    assert len(lines) == 4
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
     assert lines[2]["metric"] == "input_pipeline_prefetch_speedup"
-    assert "error" not in lines[1] and "error" not in lines[2]
+    assert lines[3]["metric"] == "fsdp_exchange_int8_wire_bytes_reduction"
+    assert all("error" not in r for r in lines[1:])
 
-    # one fallback crashing must not take the other (or exit 0) down
+    # one fallback crashing must not take the others (or exit 0) down
     monkeypatch.setattr(bench, "bench_gradexchange",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e2:
@@ -98,11 +103,14 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     lines2 = [json.loads(ln) for ln
               in capsys.readouterr().out.splitlines() if ln.strip()]
     assert [r["metric"] for r in lines2] == [
-        "backend_probe", "input_pipeline_prefetch_speedup"]
+        "backend_probe", "input_pipeline_prefetch_speedup",
+        "fsdp_exchange_int8_wire_bytes_reduction"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
     monkeypatch.setattr(bench, "bench_input_pipeline",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_fsdp_exchange",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -135,6 +143,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_input_pipeline",
         lambda: {"metric": "input_pipeline_prefetch_speedup",
                  "value": 1.8, "unit": "x", "vs_baseline": 1.2})
+    monkeypatch.setattr(
+        bench, "bench_fsdp_exchange",
+        lambda: {"metric": "fsdp_exchange_int8_wire_bytes_reduction",
+                 "value": 2.65, "unit": "x", "vs_baseline": 1.0})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -146,7 +158,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
     assert rec["failed_bench"] == "a"
     assert [r["metric"] for r in lines[1:]] == [
         "gradexchange_int8_wire_bytes_reduction",
-        "input_pipeline_prefetch_speedup"]
+        "input_pipeline_prefetch_speedup",
+        "fsdp_exchange_int8_wire_bytes_reduction"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -239,6 +252,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_input_pipeline",
         lambda: {"metric": "input_pipeline_prefetch_speedup",
                  "value": 1.8, "unit": "x", "vs_baseline": 1.2})
+    monkeypatch.setattr(
+        bench, "bench_fsdp_exchange",
+        lambda: {"metric": "fsdp_exchange_int8_wire_bytes_reduction",
+                 "value": 2.65, "unit": "x", "vs_baseline": 1.0})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -250,6 +267,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     metrics = [r["metric"] for r in lines]
     assert "gradexchange_int8_wire_bytes_reduction" in metrics
     assert "input_pipeline_prefetch_speedup" in metrics
+    assert "fsdp_exchange_int8_wire_bytes_reduction" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

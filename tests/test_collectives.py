@@ -161,22 +161,30 @@ def test_trainer_rejects_unknown_compression_mode():
         Trainer(grad_compression="fp8")
 
 
-def test_compression_rejects_sharded_params(tmpdir):
-    """FSDP shards params; the compressed exchange would silently
-    all-gather them into every replica (plus full-size residuals),
-    destroying the memory savings -- must refuse loudly."""
-    from ray_lightning_accelerators_tpu.models.mnist import (MNISTClassifier,
-                                                             synthetic_mnist)
-    x, y = synthetic_mnist(256, seed=0)
-    loader = DataLoader(ArrayDataset(x, y), batch_size=64)
+def test_compression_rejects_model_parallel_params(tmpdir):
+    """fsdp-sharded params now RIDE the compressed exchange (PR 8,
+    tests/test_fsdp_exchange.py); the boundary that remains is
+    model-parallel sharding — tensor/sequence-sharded gradients are not
+    replicas, so the refusal stays, typed."""
+    from ray_lightning_accelerators_tpu.parallel.collectives import (
+        TensorShardedParamsError)
+
+    class TPBoring(BoringModel):
+        def param_logical_axes(self):
+            return {"layer": {"kernel": ("embed", "mlp"),
+                              "bias": None}}
+
     trainer = Trainer(max_epochs=1, precision="f32", seed=0,
                       enable_checkpointing=False,
                       default_root_dir=str(tmpdir),
-                      accelerator=RayTPUAccelerator(num_workers=8,
-                                                    use_fsdp=True),
+                      accelerator=RayTPUAccelerator(num_workers=4,
+                                                    tensor=2),
                       grad_compression="int8")
-    with pytest.raises(ValueError, match="replicated params"):
-        trainer.fit(MNISTClassifier({"layer_1": 64, "layer_2": 64}), loader)
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+    loader = DataLoader(ArrayDataset(x), batch_size=16)
+    with pytest.raises(TensorShardedParamsError,
+                       match="fsdp-sharded params only"):
+        trainer.fit(TPBoring(), loader)
 
 
 def test_profiler_reset_clears_comms():

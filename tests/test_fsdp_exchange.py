@@ -1,0 +1,342 @@
+"""Compressed FSDP (ZeRO-2/3, parallel/collectives.py): quantized
+reduce-scatter into the shard owner, shard-local (1/N) error-feedback
+residuals and optimizer state, bf16 param all-gather — numerics, sharding
+layouts, checkpoint resize, typed refusals and the zero-retrace contract,
+all on the suite's 8-device CPU mesh."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_lightning_accelerators_tpu import (ArrayDataset, DataLoader,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.parallel import collectives as C
+from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+from ray_lightning_accelerators_tpu.parallel import sharding as sharding_lib
+
+pytestmark = pytest.mark.fsdp
+
+
+def _fsdp_mesh(nf=8, nd=1):
+    return mesh_lib.build_mesh(mesh_lib.MeshConfig(data=nd, fsdp=nf))
+
+
+def _put_stacked(mesh, tree):
+    lead = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+    return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), lead), tree)
+
+
+def _exchange_once(mesh, cfg, params, grads):
+    param_sh = sharding_lib.infer_fsdp_shardings(params, mesh)
+    res = _put_stacked(mesh, C.fsdp_residual_zeros(params, param_sh, cfg))
+    ex = jax.jit(C.build_fsdp_exchange(mesh, cfg, param_sh))
+    out, new_res = ex(_put_stacked(mesh, grads), res)
+    return param_sh, out, new_res
+
+
+# --------------------------------------------------------------------- #
+# Exchange numerics + shard-local layouts                                #
+# --------------------------------------------------------------------- #
+def test_int8_fsdp_exchange_error_bound_and_shard_local_residuals():
+    """Acceptance: one int8 reduce-scatter of random grads lands within
+    the SAME 1e-2 relative bound the replicated exchange meets, the
+    reduced grads come back in the param (owner) layout, and the
+    error-feedback residual is genuinely 1/N per device."""
+    mesh = _fsdp_mesh()
+    n = C.dp_size(mesh)
+    cfg = C.ExchangeConfig(mode="int8")
+    rng = np.random.default_rng(0)
+    params = {"w": np.zeros((1024, 64), np.float32),   # fsdp-sharded
+              "u": np.zeros((1001, 63), np.float32),   # indivisible dims
+              "b": np.zeros((7,), np.float32)}         # fp32 psum path
+    grads = {k: rng.normal(size=(n,) + v.shape).astype(np.float32)
+             for k, v in params.items()}
+    param_sh, out, new_res = _exchange_once(mesh, cfg, params, grads)
+    assert C.fsdp_shard_dim(param_sh["w"]) == 0
+    assert C.fsdp_shard_dim(param_sh["u"]) is None  # warn-and-replicate
+    true = jax.tree.map(lambda a: a.mean(0), grads)
+    for key in ("w", "u"):
+        t = true[key]
+        rel = np.linalg.norm(np.asarray(out[key]) - t) / np.linalg.norm(t)
+        assert rel < 1e-2, (key, rel)
+    # sub-threshold leaf rides the fp32 psum: exact (up to psum rounding)
+    np.testing.assert_allclose(np.asarray(out["b"]), true["b"], rtol=1e-6)
+    # the reduce-scattered grad lands in the OWNER layout (1/N shards);
+    # the replicated-leaf outputs stay replicated
+    assert not out["w"].sharding.is_fully_replicated
+    assert out["w"].addressable_shards[0].data.shape == (1024 // n, 64)
+    assert out["u"].sharding.is_fully_replicated
+    # residuals: shard-local [n, chunk] for the scattered leaf (1/N per
+    # device — the memory claim), full [n, size] only for the leaf that
+    # stayed on the two-phase allreduce, [n, 1] placeholder for fp32
+    chunk = (1024 * 64) // n
+    assert new_res["w"].shape == (n, chunk)
+    assert new_res["w"].addressable_shards[0].data.shape == (1, chunk)
+    assert float(jnp.linalg.norm(new_res["w"])) > 0.0
+    assert new_res["u"].shape == (n, 1001 * 63)
+    assert new_res["b"].shape == (n, 1)
+    assert float(jnp.abs(new_res["b"]).max()) == 0.0
+
+
+def test_bf16_fsdp_exchange_error_bound():
+    mesh = _fsdp_mesh()
+    n = C.dp_size(mesh)
+    cfg = C.ExchangeConfig(mode="bf16")
+    rng = np.random.default_rng(2)
+    params = {"w": np.zeros((512, 64), np.float32)}
+    grads = {"w": rng.normal(size=(n, 512, 64)).astype(np.float32)}
+    _, out, new_res = _exchange_once(mesh, cfg, params, grads)
+    true = grads["w"].mean(0)
+    rel = np.linalg.norm(np.asarray(out["w"]) - true) / np.linalg.norm(true)
+    assert rel < 5e-3
+    # bf16 chunks need no block padding: residual is exactly size/n
+    assert new_res["w"].shape == (n, (512 * 64) // n)
+
+
+def test_fsdp_exchange_on_mixed_data_fsdp_mesh():
+    """data=2 x fsdp=4: the reduce-scatter runs over fsdp, the fp32
+    psum of the 1/nf reduced shard folds in the cross-data replicas —
+    the mean must still cover all 8 replicas."""
+    mesh = _fsdp_mesh(nf=4, nd=2)
+    n = C.dp_size(mesh)
+    cfg = C.ExchangeConfig(mode="int8")
+    rng = np.random.default_rng(3)
+    params = {"w": np.zeros((512, 128), np.float32)}
+    grads = {"w": rng.normal(size=(n, 512, 128)).astype(np.float32)}
+    _, out, _ = _exchange_once(mesh, cfg, params, grads)
+    true = grads["w"].mean(0)
+    rel = np.linalg.norm(np.asarray(out["w"]) - true) / np.linalg.norm(true)
+    assert rel < 1e-2
+    assert out["w"].addressable_shards[0].data.shape == (512 // 4, 128)
+
+
+def test_param_gather_bf16_compute_view():
+    """build_param_gather returns the replicated-for-compute view: bf16
+    is what crossed the wire (values == bf16 roundtrip), dtype and
+    non-float leaves are preserved."""
+    mesh = _fsdp_mesh()
+    rng = np.random.default_rng(4)
+    params = {"w": rng.normal(size=(1024, 64)).astype(np.float32),
+              "step": np.arange(8 * 1024, dtype=np.int32).reshape(1024, 8)}
+    param_sh = {"w": NamedSharding(mesh, P(mesh_lib.FSDP_AXIS, None)),
+                "step": NamedSharding(mesh, P(mesh_lib.FSDP_AXIS, None))}
+    pd = jax.tree.map(lambda a, s: jax.device_put(jnp.asarray(a), s),
+                      params, param_sh)
+    out = jax.jit(C.build_param_gather(mesh, param_sh))(pd)
+    assert out["w"].sharding.is_fully_replicated
+    assert out["w"].dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]),
+        np.asarray(params["w"].astype(jnp.bfloat16).astype(np.float32)))
+    np.testing.assert_array_equal(np.asarray(out["step"]), params["step"])
+
+
+def test_wire_report_reduce_scatter_regime():
+    mesh = _fsdp_mesh()
+    params = {"w": np.zeros((1024, 1024), np.float32),
+              "b": np.zeros((64,), np.float32)}
+    psh = sharding_lib.infer_fsdp_shardings(params, mesh)
+    cfg = C.ExchangeConfig(mode="int8")
+    rep = C.wire_bytes_per_step(params, 8, cfg, param_shardings=psh)
+    assert rep["regime"] == "reduce_scatter_all_gather"
+    assert rep["fsdp"] == 8 and rep["reduce_scattered_leaves"] == 1
+    # int8 RS + bf16 AG vs fp32 ring allreduce: ~2.65x at block 256
+    assert 2.4 <= rep["compression_ratio"] <= 2.66
+    assert (rep["grad_reduce_scatter_bytes_per_step"]
+            + rep["param_allgather_bytes_per_step"]
+            <= rep["exchange_bytes_per_step"])
+    # the replicated regime is untouched (allreduce accounting)
+    rep_dp = C.wire_bytes_per_step(params, 8, cfg)
+    assert rep_dp["regime"] == "allreduce"
+    assert rep_dp["compressed_ratio"] >= 3.5
+
+
+def test_typed_refusal_for_model_parallel_specs():
+    assert C.fsdp_shard_dim(P(None, None)) is None
+    assert C.fsdp_shard_dim(P("fsdp", None)) == 0
+    assert C.fsdp_shard_dim(P(None, ("fsdp",))) == 1
+    with pytest.raises(C.TensorShardedParamsError, match="model-parallel"):
+        C.fsdp_shard_dim(P("tensor", None))
+    with pytest.raises(C.TensorShardedParamsError):
+        C.fsdp_shard_dim(P(("data", "fsdp"), None))  # fsdp mixed in a dim
+    with pytest.raises(C.TensorShardedParamsError):
+        C.fsdp_shard_dim(P("fsdp", "fsdp"))  # two sharded dims
+
+
+def test_fsdp_fallback_emits_telemetry_event():
+    """accelerators/base.py fallback path: a large leaf with no
+    fsdp-divisible dim warn-and-replicates AND leaves evidence — a
+    telemetry event (kind fsdp_fallback) and last_fsdp_fallbacks for
+    the trainer's profiler counter."""
+    from ray_lightning_accelerators_tpu.telemetry import recorder
+
+    mesh = _fsdp_mesh()
+    acc = RayTPUAccelerator(num_workers=8, use_fsdp=True)
+    params = {"odd": np.zeros((1001, 63), np.float32),
+              "even": np.zeros((1024, 64), np.float32)}
+    rec = recorder.get_recorder()
+    before = len([e for e in rec.events() if e["kind"] == "fsdp_fallback"])
+    sh = acc.param_shardings(mesh, params)
+    events = [e for e in rec.events() if e["kind"] == "fsdp_fallback"]
+    assert len(events) == before + 1
+    assert "odd" in events[-1]["data"]["param"]
+    assert acc.last_fsdp_fallbacks and \
+        acc.last_fsdp_fallbacks[0]["shape"] == [1001, 63]
+    assert sh["even"].spec == P(mesh_lib.FSDP_AXIS, None)
+    # the probe call (trainer residual-init path) stays quiet
+    acc.param_shardings(mesh, params, report_fallbacks=False)
+    events2 = [e for e in rec.events() if e["kind"] == "fsdp_fallback"]
+    assert len(events2) == len(events)
+
+
+# --------------------------------------------------------------------- #
+# Through the Trainer                                                    #
+# --------------------------------------------------------------------- #
+def _mnist_loader(n=512, bs=128):
+    from ray_lightning_accelerators_tpu.models.mnist import synthetic_mnist
+    x, y = synthetic_mnist(n, seed=0)
+    return DataLoader(ArrayDataset(x, y), batch_size=bs, shuffle=True)
+
+
+def _mnist_model():
+    from ray_lightning_accelerators_tpu.models.mnist import MNISTClassifier
+    return MNISTClassifier({"layer_1": 64, "layer_2": 64, "lr": 1e-3,
+                            "batch_size": 128})
+
+
+def _fit_fsdp(tmpdir, num_workers=8, max_epochs=1, **kw):
+    trainer = Trainer(max_epochs=max_epochs, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmpdir),
+                      accelerator=RayTPUAccelerator(num_workers=num_workers,
+                                                    use_fsdp=True),
+                      grad_compression="int8", **kw)
+    trainer.fit(_mnist_model(), _mnist_loader())
+    return trainer
+
+
+def test_fsdp_trainer_state_is_shard_local_and_resumes_on_fewer_shards(
+        tmpdir):
+    """The flag-to-wire acceptance path: Trainer(grad_compression='int8')
+    with use_fsdp=True trains end-to-end on the 8-dev mesh with
+    1/N-sized param/opt/residual/accum buffers (asserted via sharding
+    specs), round-trips a sharded checkpoint, and that checkpoint
+    restores onto an fsdp=4 mesh through the template-reconciliation
+    chain (residual/accum reset, params/opt redistributed)."""
+    trainer = _fit_fsdp(tmpdir.join("f8"), accumulate_grad_batches=2,
+                        checkpoint_format="sharded")
+    st = trainer._state
+    n = C.dp_size(trainer._mesh)
+    w = st.params["dense_0"]["kernel"]          # (784, 64), fsdp dim 0
+    assert w.sharding.spec == P(mesh_lib.FSDP_AXIS, None)
+    assert w.addressable_shards[0].data.shape == (784 // n, 64)
+    # ZeRO-2/3: Adam moments inherit the 1/N layout
+    sharded_moments = [
+        leaf for leaf in jax.tree.leaves(st.opt_state)
+        if hasattr(leaf, "sharding")
+        and not leaf.sharding.is_fully_replicated]
+    assert len(sharded_moments) >= 4  # mu+nu for both hidden kernels
+    assert sharded_moments[0].addressable_shards[0].data.shape[0] \
+        == sharded_moments[0].shape[0] // n
+    # shard-local residual: padded chunk of 784*64/8, held [1, chunk]
+    res = st.residual["dense_0"]["kernel"]
+    chunk = res.shape[1]
+    assert chunk < (784 * 64) // n + 256 and chunk >= (784 * 64) // n
+    assert res.addressable_shards[0].data.shape == (1, chunk)
+    # post-exchange accumulator: param-shaped, so 1/N-sharded too
+    acc = st.grad_accum["dense_0"]["kernel"]
+    assert acc.shape == (784, 64)
+    assert acc.addressable_shards[0].data.shape == (784 // n, 64)
+    # the analytic wire record reports the RS/AG regime
+    assert trainer.comms_per_step["regime"] == "reduce_scatter_all_gather"
+    assert trainer.comms_per_step["param_allgather_bytes_per_step"] > 0
+
+    # sharded checkpoint round-trip (same world)
+    from ray_lightning_accelerators_tpu.utils import \
+        sharded_checkpoint as sharded_lib
+    path = os.path.join(str(tmpdir), "f8.ckpt")
+    trainer.save_checkpoint(path)
+    restored = sharded_lib.restore_sharded(path, template=st)
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # resume onto HALF the shards (fsdp=8 -> 4): params/opt arrive via
+    # global shapes, residual/accum rebuild from the recorded saved-world
+    # shapes and reset to zero
+    trainer2 = Trainer(max_epochs=2, precision="f32", seed=0,
+                       enable_checkpointing=False,
+                       default_root_dir=str(tmpdir.join("f4")),
+                       checkpoint_format="sharded",
+                       accelerator=RayTPUAccelerator(num_workers=4,
+                                                     use_fsdp=True),
+                       grad_compression="int8", accumulate_grad_batches=2)
+    trainer2.fit(_mnist_model(), _mnist_loader(), ckpt_path=path)
+    assert trainer2.global_step > trainer.global_step
+    w2 = trainer2._state.params["dense_0"]["kernel"]
+    assert w2.addressable_shards[0].data.shape == (784 // 4, 64)
+    res2 = trainer2._state.residual["dense_0"]["kernel"]
+    assert res2.shape[0] == 4
+    assert float(jnp.abs(trainer2._state.grad_accum["dense_0"]
+                         ["kernel"]).max()) >= 0.0  # rebuilt, usable
+
+
+def test_fsdp_trainer_zero_retraces_after_warmup(tmpdir, compile_guard):
+    """The donated fsdp train step (gather + local grads + reduce-scatter
+    + shard-local update) compiles once: ZERO new backend compiles over
+    steps 2..12 (the compile_guard contract the probe also enforces)."""
+    from ray_lightning_accelerators_tpu import Callback
+    from ray_lightning_accelerators_tpu.analysis.compile_guard import (
+        compile_count)
+
+    counts = []
+
+    class CompileCounter(Callback):
+        def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+            counts.append(compile_count())
+
+    trainer = Trainer(max_steps=12, max_epochs=6, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmpdir),
+                      accelerator=RayTPUAccelerator(num_workers=8,
+                                                    use_fsdp=True),
+                      grad_compression="int8", log_every_n_steps=4,
+                      callbacks=[CompileCounter()])
+    trainer.fit(_mnist_model(), _mnist_loader())
+    assert len(counts) == 12
+    # step 1 absorbs every compile; steps 2..12 must add none
+    assert counts[1:] == [counts[0]] * 11, counts
+
+
+@pytest.mark.slow
+def test_fsdp_int8_loss_tracks_replicated_int8_dp(tmpdir):
+    """Acceptance (heavy): a 3-epoch MNIST run under compressed FSDP
+    reaches a final loss within the PR 3 int8 tolerance (2%) of the
+    replicated-int8 DP baseline — the bf16 compute view plus the
+    shard-local-EF reduce-scatter is as faithful as the allreduce."""
+    from ray_lightning_accelerators_tpu.models.mnist import synthetic_mnist
+    x, y = synthetic_mnist(2048, seed=0)
+
+    def fit(root, use_fsdp):
+        loader = DataLoader(ArrayDataset(x, y), batch_size=256,
+                            shuffle=True)
+        trainer = Trainer(max_epochs=3, precision="f32", seed=0,
+                          enable_checkpointing=False,
+                          default_root_dir=str(root),
+                          accelerator=RayTPUAccelerator(
+                              num_workers=8, use_fsdp=use_fsdp),
+                          grad_compression="int8")
+        from ray_lightning_accelerators_tpu.models.mnist import (
+            MNISTClassifier)
+        trainer.fit(MNISTClassifier({"layer_1": 64, "layer_2": 64,
+                                     "lr": 1e-3, "batch_size": 256}),
+                    loader)
+        return trainer.callback_metrics["train_loss"]
+
+    l_dp = fit(tmpdir.join("dp"), False)
+    l_fs = fit(tmpdir.join("fsdp"), True)
+    assert abs(l_fs - l_dp) / l_dp < 0.02, (l_dp, l_fs)
