@@ -19,12 +19,14 @@
   against this chip's own weight-streaming roofline probed with a
   matmul-shaped read (the access pattern decode actually has).
 
-- ``gradexchange`` / ``input_pipeline`` (CPU-mesh subprocess benches):
-  quantized-allreduce wire-bytes reduction and async-input-pipeline
-  prefetch speedup, each measured by a self-contained probe script that
-  forces an 8-device host-platform CPU mesh before backend init.  They
-  double as the dead-backend fallback set: a window whose accelerator
-  probe fails still emits their real metric lines and exits 0.
+- ``gradexchange`` / ``input_pipeline`` / ``fsdp_exchange`` /
+  ``paged_serve`` (CPU-mesh subprocess benches): quantized-allreduce
+  wire-bytes reduction, async-input-pipeline prefetch speedup,
+  compressed-FSDP exchange, and paged-KV-cache concurrency-per-HBM,
+  each measured by a self-contained probe script that forces an
+  8-device host-platform CPU mesh before backend init.  They double as
+  the dead-backend fallback set: a window whose accelerator probe fails
+  still emits their real metric lines and exits 0.
 
 Each timed region is the steady state of a single public-API ``fit`` --
 epoch 1 absorbs compile + the one-time device-cache shipment, later epochs
@@ -583,10 +585,20 @@ def bench_fsdp_exchange() -> dict:
     return _run_cpu_probe("fsdp_exchange_probe.py", "fsdp_exchange")
 
 
+def bench_paged_serve() -> dict:
+    """Paged-KV-cache serve bench (block pool + prefix reuse,
+    serve/engine.py): concurrent sequences per placed cache byte vs the
+    dense allocator on a mixed-length lognormal workload, plus the
+    measured TTFT reduction prefix hits buy — on a forced-host-platform
+    8-device CPU mesh (see ``_run_cpu_probe``)."""
+    return _run_cpu_probe("paged_serve_probe.py", "paged_serve")
+
+
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
            "input_pipeline": bench_input_pipeline,
-           "fsdp_exchange": bench_fsdp_exchange}
+           "fsdp_exchange": bench_fsdp_exchange,
+           "paged_serve": bench_paged_serve}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -610,7 +622,7 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 # subprocess: they cannot be taken down by a dead accelerator backend,
 # so they double as the probe-failure fallback set
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
-                         "fsdp_exchange")
+                         "fsdp_exchange", "paged_serve")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -622,14 +634,14 @@ def _emit_cpu_fallbacks(done=()) -> int:
     (BENCH_r04/r05 were exactly that: one error line, zero numbers).  A
     fallback failure must never mask the death record."""
     emitted = len(tuple(done))
-    fallbacks = {"gradexchange": lambda: bench_gradexchange(),
-                 "input_pipeline": lambda: bench_input_pipeline(),
-                 "fsdp_exchange": lambda: bench_fsdp_exchange()}
     for name in _CPU_FALLBACK_BENCHES:
         if name in done:
             continue
         try:
-            print(json.dumps(fallbacks[name]()), flush=True)
+            # late-bound bench_<name> lookup: no hand-maintained second
+            # registry to drift from _CPU_FALLBACK_BENCHES, and module-
+            # level monkeypatching (tests) still takes effect
+            print(json.dumps(globals()[f"bench_{name}"]()), flush=True)
             emitted += 1
         except Exception as e:
             print(f"{name} fallback failed: {type(e).__name__}: {e}",
@@ -713,7 +725,7 @@ def main() -> None:
     parser.add_argument(
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
-                "fsdp_exchange",
+                "fsdp_exchange,paged_serve",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--probe-timeout", type=float,
                         default=float(os.environ.get(

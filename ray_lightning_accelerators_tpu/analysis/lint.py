@@ -339,7 +339,18 @@ def is_jit_call_name(node: ast.AST) -> bool:
 DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     "core/trainer.py": ("Trainer._fit_step", "Trainer._run_scanned_epoch",
                         "Trainer._place_train_item"),
-    "serve/engine.py": ("ServeEngine._run",),
+    # the paged-serve hot path: the driver loop plus the block
+    # allocator's bookkeeping (alloc/release/lookup run per admit and
+    # retire, under the allocator lock — a host sync there would stall
+    # every decode step behind it)
+    "serve/engine.py": ("ServeEngine._run", "BlockAllocator.alloc",
+                        "BlockAllocator.release",
+                        "BlockAllocator.lookup_run"),
+    # the paged decode step is compiled INTO the serve loop: its builder
+    # body (and the shared paged attention block) must stay
+    # host-sync-free and build no jits
+    "models/transformer.py": ("GPT.decode_step_rows_paged",
+                              "GPT.decode_chunk_paged"),
     "utils/profiler.py": ("Profiler.span",),
     # the flight recorder's emit runs inside every other hot root: it
     # must never host-sync or allocate unboundedly (telemetry/)

@@ -22,21 +22,60 @@ Mechanics worth noting:
   path needs linear slots).
 - **Serving**: because greedy speculative decode obeys the same
   exactness contract as `serve.ServeEngine` (token-identical to target
-  greedy `generate()`), the serve engine may route single-stream
-  (batch-1) requests through this path — e.g. a latency-sensitive lane
-  with a draft model — and batch everything else; clients cannot tell
-  which path produced a response.
+  greedy `generate()`), the serve engine ROUTES single-stream (batch-1)
+  requests through this path: construct the engine with
+  ``draft_model=``/``draft_params=`` and submit with
+  ``speculative=True`` (or call `serve_speculative` below).  An idle
+  engine drafts with `build_draft_proposer` and verifies through its
+  PAGED chunk scorer — draft tokens land in the request's scratch
+  blocks and only accepted tokens' positions survive (rejected
+  positions are rewritten before the causal mask can expose them, the
+  same no-rollback property as the linear caches here).  A busy engine
+  decodes the request in a normal continuous-batching slot instead;
+  clients cannot tell which path produced a response.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .transformer import GPT
+
+
+def build_draft_proposer(draft: GPT, draft_params, k: int):
+    """Jitted draft proposer ``(cache, tok [1], pos) -> (cache, [k])``:
+    all ``k`` draft steps in ONE dispatch (a host loop of k jit calls
+    would pay k tunnel round-trips per round).  The draft cache absorbs
+    ``tok`` at ``pos`` first, then greedily extends — shared by
+    `speculative_generate` and the serve engine's speculative lane so
+    the two drafting paths cannot drift."""
+
+    def _draft_k(cache, tok, pos):
+        def step(carry, i):
+            c, t = carry
+            logits, c = draft._decode_token(draft_params, c, t, pos + i)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (c, nxt), nxt
+
+        (cache, _), toks = jax.lax.scan(
+            step, (cache, tok), jnp.arange(k))
+        return cache, toks[:, 0]  # [k] drafted tokens
+
+    return jax.jit(_draft_k)
+
+
+def serve_speculative(engine: Any, prompt, max_new_tokens: int,
+                      timeout: Optional[float] = None) -> np.ndarray:
+    """Route one single-stream request through a running ServeEngine's
+    speculative lane (the engine must carry a draft model).  Blocks for
+    the full token sequence — token-identical to target-only greedy
+    `generate()` whichever lane actually served it."""
+    return engine.submit(prompt, max_new_tokens,
+                         speculative=True).result(timeout)
 
 
 def speculative_generate(target: GPT, target_params,
@@ -87,20 +126,7 @@ def speculative_generate(target: GPT, target_params,
         h_t, t_cache = target._prefill(target_params, prompt, cache_len)
         _, d_cache = draft._prefill(draft_params, prompt, cache_len)
 
-        def _draft_k(cache, tok, pos):
-            # all k draft steps in ONE dispatch (a host loop of k jit calls
-            # would pay k tunnel round-trips per round)
-            def step(carry, i):
-                c, t = carry
-                logits, c = draft._decode_token(draft_params, c, t, pos + i)
-                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-                return (c, nxt), nxt
-
-            (cache, _), toks = jax.lax.scan(
-                step, (cache, tok), jnp.arange(k))
-            return cache, toks[:, 0]  # [k] drafted tokens
-
-        d_propose = jax.jit(_draft_k)
+        d_propose = build_draft_proposer(draft, draft_params, k)
         t_chunk = jax.jit(lambda c, toks, p: target._decode_chunk(
             target_params, c, toks, p))
 

@@ -133,6 +133,26 @@ def _rope_rows(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _rope_grid(x: jax.Array, positions: jax.Array,
+               theta: float) -> jax.Array:
+    """Rotary embeddings with a PER-ROW, PER-QUERY position grid.
+    x: [b, h, n, d], positions: [b, n] — the paged decode paths, where
+    every batch row carries its own vector of query positions (n == 1
+    for the batched step, b == 1 for chunk scoring).  Element-for-element
+    the same arithmetic as `_rope`/`_rope_rows`, so a query at position p
+    matches the dense decode paths exactly."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = (positions[..., None].astype(jnp.float32)
+              * freqs[None, None, :])                  # [b, n, d/2]
+    cos = jnp.cos(angles)[:, None, :, :]               # [b, 1, n, d/2]
+    sin = jnp.sin(angles)[:, None, :, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
 def _remat_policy(name: str):
     """Map a config string to a jax.checkpoint policy."""
     policies = {
@@ -943,6 +963,176 @@ class GPT(TpuModule):
         h = self._rms_norm(h, params["ln_f"])
         logits = self._unembed_matmul(h[:, 0], params, dt)
         return logits, {"k": cks, "v": cvs}
+
+    # ------------------------------------------------------------------ #
+    # Block-paged decode (serve engine's paged KV cache)                 #
+    # ------------------------------------------------------------------ #
+    # Instead of one dense [L, B, H, W, D] cache, the pool is a fixed set
+    # of [L, n_blocks, H, block_len, D] KV blocks plus a per-row int32
+    # block table mapping logical position p to physical block
+    # table[p // block_len], offset p % block_len.  Tables are TRACED
+    # operands: join/retire/grow is a host-side table write, never a
+    # recompile — the PR 2 invariant, kept through the indirection.
+    # Attention reads the pool through a gather over the table; masked
+    # positions contribute exactly-zero softmax terms, so the arithmetic
+    # per attended position is identical to the dense decode paths
+    # (token-exactness vs generate() rides on that, test-asserted).
+
+    def paged_cache_alloc(self, n_blocks: int, block_len: int):
+        """Zeroed block pool [L, n_blocks, kv_heads, block_len, head_dim]
+        in the compute dtype — the paged serve engine's fixed HBM
+        footprint (block 0 is conventionally the engine's garbage block:
+        inactive decode rows scatter there, it is never table-mapped)."""
+        cfg = self.cfg
+        shape = (cfg.n_layers, n_blocks, cfg.kv_heads, block_len,
+                 cfg.head_dim)
+        return {"k": jnp.zeros(shape, self.compute_dtype),
+                "v": jnp.zeros(shape, self.compute_dtype)}
+
+    @staticmethod
+    def paged_cache_join(pool, row_cache, blocks):
+        """Scatter a single-request linear cache [L,1,H,P,D] into the
+        physical ``blocks`` ([P // block_len] int32, traced) of a paged
+        pool — the block-table analog of ``cache_join``.  P must be a
+        multiple of the pool's block_len (the engine buckets prompts to
+        block multiples)."""
+
+        def put(pool_a, row):
+            L, _, H, P, D = row.shape
+            bl = pool_a.shape[3]
+            r = row[:, 0].reshape(L, H, P // bl, bl, D
+                                  ).transpose(0, 2, 1, 3, 4)
+            return pool_a.at[:, blocks].set(r.astype(pool_a.dtype))
+
+        return {"k": put(pool["k"], row_cache["k"]),
+                "v": put(pool["v"], row_cache["v"])}
+
+    def _paged_attn_block(self, h, lp, pk, pv, tables, positions):
+        """One layer over the block-paged pool.  h: [B, n, d]; pk/pv:
+        [n_blocks, H, block_len, D] (ONE layer's pool); tables: [B, M]
+        int32 physical block ids; positions: [B, n] int32 query
+        positions.  Each query's k/v is scattered to its table-mapped
+        slot first, then every row gathers its table's blocks into a
+        [H, M*block_len, D] view and attends with mask t <= position —
+        one implementation for both paged programs (batched step n == 1,
+        chunk scoring B == 1) so they cannot drift apart.  Unmapped table
+        entries (sentinel 0) only cover positions t > position, which the
+        mask closes; the garbage block's values are finite (pool-zeroed,
+        then finite writes), so masked lanes stay exactly zero."""
+        cfg = self.cfg
+        dt = self.compute_dtype
+        a = lp["attn"]
+        b, n, _ = h.shape
+        bl = pk.shape[2]
+        x = self._rms_norm(h, lp["ln1"])
+        q = self._qkv_proj_decode(x, a["wq"], dt)        # [B, H, n, D]
+        k = self._qkv_proj_decode(x, a["wk"], dt)
+        v = self._qkv_proj_decode(x, a["wv"], dt)
+        q = _rope_grid(q, positions, cfg.rope_theta)
+        k = _rope_grid(k, positions, cfg.rope_theta)
+        # per-query scatter: query (b, i) writes its k/v at physical
+        # block tables[b, pos // bl], offset pos % bl (a traced scatter;
+        # distinct live rows own distinct blocks, so writes never
+        # collide — inactive rows all target the garbage block 0, where
+        # last-write-wins garbage is harmless)
+        phys = jnp.take_along_axis(tables, positions // bl, axis=1)
+        off = positions % bl                             # [B, n]
+        pk = pk.at[phys, :, off, :].set(
+            k.transpose(0, 2, 1, 3).astype(pk.dtype))
+        pv = pv.at[phys, :, off, :].set(
+            v.transpose(0, 2, 1, 3).astype(pv.dtype))
+        kvh = pk.shape[1]
+        M = tables.shape[1]
+        W = M * bl
+        kb = pk[tables].transpose(0, 2, 1, 3, 4).reshape(b, kvh, W, -1)
+        vb = pv[tables].transpose(0, 2, 1, 3, 4).reshape(b, kvh, W, -1)
+        groups = cfg.n_heads // kvh
+        qg = q.astype(jnp.float32).reshape(b, kvh, groups, n,
+                                           cfg.head_dim)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, kb.astype(jnp.float32)
+                       ) * cfg.head_dim ** -0.5
+        t = jnp.arange(W)[None, None, None, None, :]
+        rows = positions[:, None, None, :, None]
+        s = jnp.where(t <= rows, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bkgqt,bktd->bkgqd", p, vb.astype(jnp.float32))
+        attn = attn.reshape(b, cfg.n_heads, n, cfg.head_dim).astype(dt)
+        h = h + self._attn_out_proj_decode(attn, a["wo"], dt)
+        x = self._rms_norm(h, lp["ln2"])
+        if cfg.num_experts > 1:
+            m = self._dequant_q8_leaves(lp["mlp"], dt)
+            y, _ = moe_mlp(x, m, top_k=cfg.moe_top_k,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           compute_dtype=dt, mesh=self.mesh)
+        else:
+            m = lp["mlp"]
+            up = jax.nn.gelu(self._mlp_proj_decode(x, m["wi"], dt))
+            y = self._mlp_proj_decode(up, m["wo"], dt)
+        return h + y, pk, pv
+
+    def decode_step_rows_paged(self, params, pool, tables, tokens,
+                               positions):
+        """``decode_step_rows`` through the block-table indirection: one
+        full-depth single-token step for every row at once, each row
+        reading/writing the pool via its own table row.  tables: [B, M]
+        int32 (traced — join/retire/grow never recompiles); tokens /
+        positions: [B] int32.  Rows the caller considers inactive must
+        carry an all-zero table (the garbage block) and any in-range
+        position.  Returns (logits [B, V] f32, updated pool)."""
+        dt = self.compute_dtype
+        positions = jnp.asarray(positions, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        h = self._embed_lookup(params, tokens)[:, None]  # [B, 1, d]
+
+        def layer(carry, xs):
+            lp, pk, pv = xs
+            h_out, pk2, pv2 = self._paged_attn_block(
+                carry, lp, pk, pv, tables, positions[:, None])
+            return h_out, (pk2, pv2)
+
+        h, (pks, pvs) = jax.lax.scan(
+            layer, h, (params["layers"], pool["k"], pool["v"]))
+        h = self._rms_norm(h, params["ln_f"])
+        logits = self._unembed_matmul(h[:, 0], params, dt)
+        return logits, {"k": pks, "v": pvs}
+
+    def decode_chunk_paged(self, params, pool, table, tokens, pos0,
+                           last_index=None):
+        """Single-row chunk scoring/prefill through the paged pool: n
+        tokens fed at positions pos0..pos0+n-1, attending to whatever the
+        row's ``table`` ([M] int32) already maps (a shared prefix, prior
+        rounds) plus causally to themselves; their k/v land in the
+        table-mapped blocks.  This is both the paged prefill (the suffix
+        after any shared-prefix blocks, with ``last_index`` selecting the
+        true last prompt token's logits [1, V]) and the speculative chunk
+        scorer (``last_index=None`` → logits [1, n, V]; logits[:, i]
+        predicts position pos0+i+1).  Returns (logits, pool)."""
+        dt = self.compute_dtype
+        n = tokens.shape[1]
+        pos = (jnp.asarray(pos0, jnp.int32)
+               + jnp.arange(n, dtype=jnp.int32))[None]  # [1, n]
+        table = jnp.asarray(table, jnp.int32)
+        h = self._embed_lookup(params, tokens)
+
+        def layer(carry, xs):
+            lp, pk, pv = xs
+            h_out, pk2, pv2 = self._paged_attn_block(
+                carry, lp, pk, pv, table[None], pos)
+            return h_out, (pk2, pv2)
+
+        h, (pks, pvs) = jax.lax.scan(
+            layer, h, (params["layers"], pool["k"], pool["v"]))
+        h = self._rms_norm(h, params["ln_f"])
+        pool = {"k": pks, "v": pvs}
+        if last_index is None:
+            b, nn, d = h.shape
+            logits = self._unembed_matmul(h.reshape(b * nn, d), params,
+                                          dt).reshape(b, nn, -1)
+            return logits, pool
+        idx = jnp.asarray(last_index, jnp.int32)
+        logits = self._unembed_matmul(
+            h[jnp.arange(h.shape[0]), idx], params, dt)
+        return logits, pool
 
     @staticmethod
     def _sample(logits, temperature, top_k, top_p, rng):

@@ -7,10 +7,13 @@ profiling (utils/profiler.py).  This package composes them into a
 request-serving engine:
 
 - **batcher**: bounded admission with typed backpressure (``QueueFull``,
-  ``RequestRejected``, ``ServeCancelled``);
-- **engine**: the continuous-batching driver loop — fixed decode slots
-  over an up-front [L, B, H, total_len, D] cache, so joining/retiring
-  sequences mid-flight is a slot write, never a recompile;
+  ``PoolExhausted``, ``RequestRejected``, ``ServeCancelled``);
+- **engine**: the continuous-batching driver loop — a block-paged KV
+  pool read through traced per-slot block tables (with chain-hashed
+  shared-prefix reuse and an optional speculative lane), so
+  joining/retiring/growing sequences mid-flight is a table write,
+  never a recompile (``paged=False`` keeps the dense up-front
+  [L, B, H, total_len, D] cache);
 - **metrics**: throughput, queue depth, TTFT and per-token latency at
   p50/p95/p99/max via the profiler's reservoir percentiles;
 - **replicas**: N engine replicas on the existing ``ActorPool`` with
@@ -21,14 +24,16 @@ Exactness is the contract: every response is token-identical to a
 standalone greedy ``GPT.generate()`` of the same prompt.
 """
 
-from .batcher import (AdmissionController, QueueFull, RequestRejected,
-                      ServeCancelled, ServeRequest, ServeResponse)
-from .engine import ServeEngine
+from .batcher import (AdmissionController, PoolExhausted, QueueFull,
+                      RequestRejected, ServeCancelled, ServeRequest,
+                      ServeResponse, blocks_for_request)
+from .engine import BlockAllocator, ServeEngine
 from .metrics import ServeMetrics
 from .replicas import ServeReplicas
 
 __all__ = [
-    "AdmissionController", "QueueFull", "RequestRejected",
-    "ServeCancelled", "ServeRequest", "ServeResponse",
-    "ServeEngine", "ServeMetrics", "ServeReplicas",
+    "AdmissionController", "PoolExhausted", "QueueFull",
+    "RequestRejected", "ServeCancelled", "ServeRequest", "ServeResponse",
+    "BlockAllocator", "ServeEngine", "ServeMetrics", "ServeReplicas",
+    "blocks_for_request",
 ]

@@ -47,6 +47,41 @@ class QueueFull(RuntimeError):
         self.limit = limit
 
 
+class PoolExhausted(QueueFull):
+    """Block-pool backpressure: admitting this request would overcommit
+    the paged KV pool — the admitted-but-unfinished set's worst-case
+    block demand already covers the pool.  Retryable after responses
+    drain (the 429 analog for cache MEMORY rather than queue slots);
+    distinct from ``RequestRejected``, which means the request could
+    NEVER fit."""
+
+    def __init__(self, needed: int, outstanding: int, total: int,
+                 overcommit: float):
+        RuntimeError.__init__(
+            self,
+            f"serve block pool exhausted: request needs {needed} KV "
+            f"blocks but {outstanding} are already committed to admitted "
+            f"requests against a pool of {total} blocks "
+            f"(overcommit {overcommit:g}); retry after responses drain")
+        self.needed = needed
+        self.outstanding = outstanding
+        self.total = total
+
+
+def blocks_for_request(prompt_len: int, max_new_tokens: int,
+                       block_len: int, headroom: int = 0) -> int:
+    """Worst-case KV blocks a request pins: enough to cover every
+    position its lifecycle writes — the right-padded prompt bucket
+    (``ceil(prompt_len / block_len) * block_len`` positions) and the
+    decode feeds up to position ``prompt_len + max_new_tokens - 2``
+    (the final token is sampled, never fed).  ``headroom`` extends the
+    top position for speculative chunk scoring, which drafts up to
+    ``spec_k`` positions past the newest real token."""
+    top = max(prompt_len + max_new_tokens - 1 + headroom,
+              -(-prompt_len // block_len) * block_len)
+    return -(-top // block_len)
+
+
 class RequestRejected(ValueError):
     """The request can never be served by this engine (empty prompt, non
     positive budget, prompt + budget past the cache length).  Not
@@ -73,6 +108,15 @@ class ServeRequest:
     # (admit -> prefill -> decode -> respond) correlates — across
     # replicas too, since the id travels with the request on requeue
     trace_id: Optional[str] = None
+    # speculative-lane HINT: an idle engine with a draft model routes
+    # this request through greedy speculative decode (same exactness
+    # contract, so the response is indistinguishable); a busy engine
+    # decodes it in a normal slot
+    speculative: bool = False
+    # paged admission: worst-case KV blocks this request pins, stamped by
+    # the controller so engine placement and controller accounting can
+    # never disagree (0 = dense engine, no pool accounting)
+    blocks_reserved: int = 0
 
 
 class ServeResponse:
@@ -118,22 +162,53 @@ class AdmissionController:
 
     ``queue_depth``: cap on requests queued but not yet decoding — the
     backpressure knob.  ``max_total_len``: per-request budget check
-    (prompt + max_new_tokens must fit the decode cache).
+    (prompt + max_new_tokens must fit the dense decode cache).
     ``max_new_tokens_cap``: optional per-request generation budget cap.
+
+    **Paged mode** (``block_len`` set): admission is judged against the
+    BLOCK POOL, not ``max_total_len`` — a request is rejected typed only
+    when its worst-case block demand can never fit (more blocks than the
+    per-slot table or the whole pool holds, both named in the error),
+    and ``PoolExhausted`` backpressure fires when the admitted-but-
+    unfinished set's demand would overcommit the pool past
+    ``pool_overcommit`` (prefix sharing makes real usage lower than the
+    worst case, which is what the overcommit knob trades on).
     """
 
     def __init__(self, queue_depth: int = 64,
                  max_total_len: Optional[int] = None,
-                 max_new_tokens_cap: Optional[int] = None):
+                 max_new_tokens_cap: Optional[int] = None,
+                 block_len: Optional[int] = None,
+                 pool_blocks: Optional[int] = None,
+                 max_blocks_per_slot: Optional[int] = None,
+                 spec_headroom: int = 0,
+                 pool_overcommit: float = 1.0,
+                 hard_total_cap: Optional[int] = None):
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
+        if block_len is not None and (pool_blocks is None
+                                      or max_blocks_per_slot is None):
+            raise ValueError("paged admission needs block_len, "
+                             "pool_blocks AND max_blocks_per_slot")
         self.queue_depth = queue_depth
         self.max_total_len = max_total_len
         self.max_new_tokens_cap = max_new_tokens_cap
+        self.block_len = block_len
+        self.pool_blocks = pool_blocks
+        self.max_blocks_per_slot = max_blocks_per_slot
+        self.spec_headroom = spec_headroom
+        self.pool_overcommit = pool_overcommit
+        # the MODEL's physical ceiling (max_seq_len): block rounding may
+        # grant a table more positions than max_total_len, but no cache
+        # layout can serve positions the model was never shaped for —
+        # and generate() refuses them, so the exactness contract
+        # requires the engine to refuse them too
+        self.hard_total_cap = hard_total_cap
         self._q = TrampolineQueue()
         self._requeue: deque = deque()
         self._cond = threading.Condition()
         self._depth = 0
+        self._outstanding_blocks = 0
         self._closed = False
         self._ids = itertools.count()
 
@@ -146,10 +221,11 @@ class AdmissionController:
     def closed(self) -> bool:
         return self._closed
 
-    def submit(self, prompt: Any, max_new_tokens: int) -> ServeResponse:
+    def submit(self, prompt: Any, max_new_tokens: int,
+               speculative: bool = False) -> ServeResponse:
         """Admit a request or raise typed: ``RequestRejected`` (can never
-        be served), ``QueueFull`` (backpressure), ``ServeCancelled``
-        (controller shut down)."""
+        be served), ``QueueFull``/``PoolExhausted`` (backpressure),
+        ``ServeCancelled`` (controller shut down)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise RequestRejected("empty prompt")
@@ -161,7 +237,32 @@ class AdmissionController:
             raise RequestRejected(
                 f"max_new_tokens {max_new_tokens} exceeds the engine cap "
                 f"{self.max_new_tokens_cap}")
-        if self.max_total_len is not None \
+        needed = 0
+        if self.block_len is not None:
+            if self.hard_total_cap is not None \
+                    and prompt.size + max_new_tokens > self.hard_total_cap:
+                raise RequestRejected(
+                    f"prompt ({prompt.size}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds the model's max_seq_len "
+                    f"{self.hard_total_cap} (generate() refuses the same "
+                    "request; block rounding cannot grant positions the "
+                    "model was never shaped for)")
+            # paged admission: judge against the pool's budgets, never a
+            # dense per-slot length the paging indirection made obsolete
+            needed = blocks_for_request(
+                int(prompt.size), int(max_new_tokens), self.block_len,
+                self.spec_headroom if speculative else 0)
+            if needed > self.max_blocks_per_slot \
+                    or needed > self.pool_blocks:
+                raise RequestRejected(
+                    f"prompt ({prompt.size}) + max_new_tokens "
+                    f"({max_new_tokens}) needs {needed} KV blocks of "
+                    f"{self.block_len} tokens, exceeding the per-slot "
+                    f"block-table budget ({self.max_blocks_per_slot} "
+                    f"blocks = {self.max_blocks_per_slot * self.block_len}"
+                    f" tokens) or the whole pool "
+                    f"({self.pool_blocks} blocks)")
+        elif self.max_total_len is not None \
                 and prompt.size + max_new_tokens > self.max_total_len:
             raise RequestRejected(
                 f"prompt ({prompt.size}) + max_new_tokens "
@@ -172,14 +273,50 @@ class AdmissionController:
                 raise ServeCancelled("serve queue is shut down")
             if self._depth >= self.queue_depth:
                 raise QueueFull(self._depth, self.queue_depth)
+            if self.block_len is not None and \
+                    self._outstanding_blocks + needed > \
+                    self.pool_overcommit * self.pool_blocks:
+                raise PoolExhausted(needed, self._outstanding_blocks,
+                                    self.pool_blocks,
+                                    self.pool_overcommit)
             req = ServeRequest(next(self._ids), prompt,
                                int(max_new_tokens), time.monotonic(),
-                               trace_id=mint_trace_id())
+                               trace_id=mint_trace_id(),
+                               speculative=bool(speculative),
+                               blocks_reserved=needed)
+            self._outstanding_blocks += needed
             resp = ServeResponse(req)
             self._q.put((req, resp))
             self._depth += 1
             self._cond.notify_all()
         return resp
+
+    def release_blocks(self, req: ServeRequest) -> None:
+        """Return a finished/failed request's worst-case block
+        reservation to the admission budget (exactly once per admitted
+        request; the engine calls this wherever the response resolves).
+        No-op for dense controllers."""
+        if req.blocks_reserved <= 0:
+            return
+        with self._cond:
+            self._outstanding_blocks = max(
+                0, self._outstanding_blocks - req.blocks_reserved)
+            req.blocks_reserved = 0
+            self._cond.notify_all()
+
+    def push_front(self, item: Tuple[ServeRequest, ServeResponse]) -> None:
+        """Head-of-line put-back for FLOW CONTROL (the pool cannot place
+        the popped request right now).  Unlike ``requeue`` this is not an
+        infra failure: no requeue count, FIFO order preserved."""
+        with self._cond:
+            if self._closed:
+                item[1]._fail(ServeCancelled(
+                    f"request {item[0].request_id} cancelled: engine "
+                    "shut down while it awaited pool capacity"))
+                return
+            self._requeue.appendleft(item)
+            self._depth += 1
+            self._cond.notify_all()
 
     def requeue(self, req: ServeRequest, resp: ServeResponse) -> bool:
         """Head-of-line re-admission after an infra failure (replica
@@ -234,6 +371,7 @@ class AdmissionController:
             drained.extend(self._requeue)
             self._requeue.clear()
             self._depth = 0
+            self._outstanding_blocks = 0
             self._cond.notify_all()
         n = 0
         for req, resp in drained:

@@ -1,59 +1,199 @@
 """Continuous-batching serve engine over the static-shaped decode loop.
 
-The decode cache is allocated ``[L, max_slots, H, max_total_len, D]`` up
-front, so the engine's whole lifecycle is THREE compiled programs, all
-static-shaped, none ever retraced per request:
+**Paged KV cache (default)**: instead of one dense
+``[L, max_slots, H, max_total_len, D]`` cache — which pins HBM
+proportional to ``max_total_len − actual_len`` for every slot — the
+engine owns a fixed pool of ``[L, n_blocks, H, block_len, D]`` KV blocks
+plus a per-slot int32 block table.  Decode attention reads through the
+indirection (a gather over the table INSIDE the jitted step; tables are
+traced operands), so the engine's whole lifecycle is TWO compiled
+program families, none ever retraced per request:
 
-- **prefill** (one per prompt-length bucket): run a right-padded prompt,
-  return the first greedy token and a single-row cache;
-- **join**: dynamic_update_slice the row cache into a free slot (slot
-  index is traced — admitting never recompiles);
-- **step**: one ``decode_step_rows`` over ALL slots at per-row positions,
-  argmax per row.
+- **chunk prefill** (one per suffix-length bucket): run the right-padded
+  un-shared part of a prompt through `GPT.decode_chunk_paged`, writing
+  its k/v into the request's table-mapped blocks and returning the first
+  greedy token;
+- **step**: one ``decode_step_rows_paged`` over ALL slots at per-row
+  positions, argmax per row.
 
-Joining and retiring sequences mid-flight is therefore a slot write and a
-host-side slot free — the veScale-style per-replica eager model: one
-process, one fixed mesh (decode runs replicated, like ``generate()``),
-requests streaming through fixed-shape programs.
+Joining, retiring and GROWING a sequence (its position crossing a block
+boundary into the next pre-reserved block) are host-side table writes —
+the PR 2 no-recompile invariant, preserved through the indirection and
+pinned by ``analysis.compile_guard`` in the tests.
 
-**Exactness contract**: greedy only; every response is token-identical to
-a standalone ``GPT.generate(prompt, max_new_tokens)`` of that prompt.
-This holds because prefill/step reuse the same ``_decode_attn_block``
-arithmetic, pad positions are causally masked (prefill) or rewritten
-before the mask exposes them (decode), and softmax over the wider shared
-cache adds only exactly-zero terms.  The CPU test suite asserts it
-token-for-token.
+**Shared-prefix reuse**: prompts are hashed block-wise at admission
+(a chain hash, so a block key commits to the WHOLE prefix before it);
+full blocks matching the allocator's LRU prefix index are mapped into
+the new request's table with a refcount instead of re-prefilled —
+system-prompt-heavy traffic skips most of its prefill compute and
+shares the HBM.  This is copy-on-write where the copy branch is
+provably unreachable: sharers only ever WRITE at positions past their
+shared full-prefix blocks (suffix prefill starts at the first un-shared
+block; decode writes at ``pos >= prompt_len``), so refcounts alone
+guarantee safety.  Evicting an unreferenced cached block is an LRU pop.
 
-Single-stream note: a batch-1 request could equally be routed through
-``models.speculative.speculative_generate`` (its linear-cache chunk
-scoring is join-compatible); the engine keeps greedy slots for
-simplicity, but the speculative path enforces the same exactness
-contract, so a router may mix them per request.
+**Speculative lane**: constructed with a draft model, an idle engine
+routes ``submit(..., speculative=True)`` requests through greedy
+speculative decode — the draft proposes ``spec_k`` tokens per round
+(`models.speculative.build_draft_proposer`), the target verifies them
+in ONE paged chunk pass that drafts into the request's scratch blocks,
+and only accepted tokens' positions survive (rejected positions are
+rewritten before the causal mask can expose them — the linear-cache
+no-rollback property, inherited by the paged layout).  A busy engine
+decodes the same request in a normal slot; either lane obeys the
+exactness contract, so clients cannot tell them apart.
+
+**Exactness contract**: greedy only; every response is token-identical
+to a standalone ``GPT.generate(prompt, max_new_tokens)`` of that
+prompt.  This holds because the paged attention performs the same
+arithmetic per attended position as the dense decode paths (gathers are
+exact value copies; masked positions contribute exactly-zero softmax
+terms), pad positions are rewritten before the mask exposes them, and
+shared prefix blocks hold bit-identical k/v for an identical token
+prefix (k/v are deterministic functions of the prefix).  The CPU test
+suite asserts it token-for-token — across staggered join/retire, block
+growth, prefix hits and the speculative lane.
+
+``paged=False`` keeps the PR 2 dense allocator (three compiled
+programs: bucketed pad-prefill, slot join, batched step) — the probe
+uses it as the placed-bytes baseline the paged pool is judged against.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
-                      ServeResponse)
+                      ServeResponse, blocks_for_request)
 from .metrics import ServeMetrics
+
+
+class BlockAllocator:
+    """Host-side bookkeeping for the paged pool's physical blocks: a
+    free list, per-block refcounts, and an LRU prefix index mapping
+    chain-hash keys of FULL prompt blocks to the physical block holding
+    their k/v.
+
+    Lifetimes: a freshly allocated block starts at refcount 1 (its
+    owner); a prefix hit retains (+1) the shared block for the new
+    sharer.  ``release`` drops a reference; an unreferenced block
+    returns to the free list UNLESS it is registered in the prefix
+    index, where it stays resident as reusable cache until LRU eviction
+    reclaims it for a new allocation.  Block 0 is reserved as the
+    garbage block (inactive decode rows scatter there) and is never
+    handed out.
+
+    Thread-safety: a single lock — the engine loop owns alloc/release,
+    but the metrics gauge reads ``stats()`` from other threads.
+    """
+
+    def __init__(self, n_blocks: int, block_len: int):
+        if n_blocks < 2:
+            raise ValueError("the pool needs >= 2 blocks (block 0 is "
+                             "the reserved garbage block)")
+        if block_len < 1:
+            raise ValueError("block_len must be >= 1")
+        self.n_blocks = n_blocks
+        self.block_len = block_len
+        self._lock = threading.Lock()
+        self._free: deque = deque(range(1, n_blocks))
+        self._ref = np.zeros((n_blocks,), np.int32)
+        self._index: "OrderedDict[str, int]" = OrderedDict()  # LRU
+        self._key_of: Dict[int, str] = {}
+
+    # ------------------------------------------------------------------ #
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh blocks at refcount 1, or None when even evicting
+        every unreferenced cached prefix block cannot free enough."""
+        with self._lock:
+            if n <= 0:
+                return []
+            while len(self._free) < n:
+                if not self._evict_one_locked():
+                    return None
+            out = [self._free.popleft() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def _evict_one_locked(self) -> bool:
+        victim = None
+        for key, blk in self._index.items():  # oldest (LRU) first
+            if self._ref[blk] == 0:
+                victim = (key, blk)
+                break
+        if victim is None:
+            return False
+        key, blk = victim
+        del self._index[key]
+        del self._key_of[blk]
+        self._free.append(blk)
+        return True
+
+    def lookup_run(self, keys: List[str], max_blocks: int) -> List[int]:
+        """Longest run of prefix-index hits from block 0, each RETAINED
+        for the caller (and bumped to MRU).  ``max_blocks`` caps the run
+        (the engine keeps >= 1 suffix token so the last prompt hidden
+        state is actually computed)."""
+        out: List[int] = []
+        with self._lock:
+            for key in keys[:max_blocks]:
+                blk = self._index.get(key)
+                if blk is None:
+                    break
+                self._index.move_to_end(key)
+                self._ref[blk] += 1
+                out.append(blk)
+        return out
+
+    def release(self, block: int) -> None:
+        """Drop one reference; unreferenced unregistered blocks go back
+        to the free list, registered ones stay cached (evictable)."""
+        with self._lock:
+            self._ref[block] -= 1
+            if self._ref[block] <= 0:
+                self._ref[block] = 0
+                if block not in self._key_of:
+                    self._free.append(block)
+
+    def register(self, key: str, block: int) -> bool:
+        """Publish a full prompt block under its chain-hash key for
+        future prefix hits.  First writer wins: if another block already
+        carries the key (two identical prompts admitted concurrently),
+        the caller's block stays private and is freed at retire."""
+        with self._lock:
+            if key in self._index or block in self._key_of:
+                return False
+            self._index[key] = block
+            self._key_of[block] = key
+            return True
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            used = int((self._ref[1:] > 0).sum())
+            cached = sum(1 for b in self._key_of
+                         if self._ref[b] == 0)
+            return {"total": self.n_blocks - 1, "used": used,
+                    "cached": cached, "free": len(self._free)}
 
 
 class _Slot:
     """Host-side state of one active decode slot."""
 
     __slots__ = ("req", "resp", "pos", "last", "generated", "remaining",
-                 "t_last")
+                 "t_last", "blocks")
 
     def __init__(self, req: ServeRequest, resp: ServeResponse, pos: int,
-                 first_token: int, t_now: float):
+                 first_token: int, t_now: float,
+                 blocks: Optional[List[int]] = None):
         self.req = req
         self.resp = resp
         self.pos = pos                    # position of the token to feed
@@ -61,17 +201,29 @@ class _Slot:
         self.generated = [first_token]
         self.remaining = req.max_new_tokens - 1
         self.t_last = t_now               # per-token latency anchor
+        self.blocks = blocks or []        # physical KV blocks (paged)
 
 
 class ServeEngine:
     """Continuous-batching greedy inference over one model replica.
 
-    ``max_slots``: fixed decode batch (the cache's B).  ``queue_depth``:
-    admission cap beyond the slots (backpressure).  ``max_total_len``:
-    per-slot cache length; prompt + max_new_tokens of every request must
-    fit (defaults to the model's max_seq_len).  ``prompt_block``: prompts
-    are right-padded to multiples of this, bounding prefill compile count
-    without unbounded padding waste.
+    ``max_slots``: fixed decode batch.  ``queue_depth``: admission cap
+    beyond the slots (backpressure).  ``max_total_len``: per-slot token
+    budget; prompt + max_new_tokens of every request must fit (defaults
+    to the model's max_seq_len).
+
+    Paged knobs (``paged=True``, the default): ``block_len`` tokens per
+    KV block; ``n_blocks`` physical blocks in the pool (+1 reserved
+    garbage block; default gives every slot its full ``max_total_len``
+    worth — shrink it to trade worst-case capacity for HBM, admission
+    rejects/backpressures typed against the real pool);
+    ``prefix_cache`` enables shared-prefix reuse;
+    ``pool_overcommit`` scales the admission-time worst-case block
+    budget (> 1.0 banks on prefix sharing).  ``draft_model`` /
+    ``draft_params`` / ``spec_k`` arm the speculative lane.
+
+    ``paged=False``: the PR 2 dense allocator; ``prompt_block`` then
+    bounds prefill compile count (paged mode buckets by ``block_len``).
     """
 
     def __init__(self, model: Any, params: Any, *, max_slots: int = 4,
@@ -80,7 +232,15 @@ class ServeEngine:
                  max_new_tokens_cap: Optional[int] = None,
                  prompt_block: int = 8,
                  metrics: Optional[ServeMetrics] = None,
-                 idle_poll_s: float = 0.05):
+                 idle_poll_s: float = 0.05,
+                 paged: bool = True,
+                 block_len: int = 16,
+                 n_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 pool_overcommit: float = 1.0,
+                 draft_model: Any = None,
+                 draft_params: Any = None,
+                 spec_k: int = 4):
         import jax
 
         if model.cfg.sliding_window is not None:
@@ -97,46 +257,134 @@ class ServeEngine:
                 f"max_total_len {W} exceeds the model's max_seq_len "
                 f"{model.cfg.max_seq_len}")
         self.model = model
-        # decode replicated, exactly like generate(): a training-time mesh
-        # must not carve up step-sized activations
-        self._mesh_saved, model.mesh = model.mesh, None
         self.params = jax.tree.map(jax.numpy.asarray, params)
         self.max_slots = max_slots
         self.max_total_len = W
-        self.prompt_block = max(1, prompt_block)
+        self.paged = bool(paged)
         self.metrics = metrics or ServeMetrics()
-        self.batcher = AdmissionController(
-            queue_depth=queue_depth, max_total_len=W,
-            max_new_tokens_cap=max_new_tokens_cap)
-        self.metrics.bind_queue(lambda: self.batcher.depth)
         self._idle_poll_s = idle_poll_s
         self._jax = jax
-        # donate the cache operand where donation is real (TPU/GPU): the
-        # hot loop reassigns self._cache every call, so without donation
-        # each step/join copies the whole [L,B,H,W,D] pair and doubles
-        # peak cache memory.  CPU ignores donation with a warning per
-        # call site -- skip it there to keep test logs quiet.
+        # donate the cache/pool operand where donation is real (TPU/GPU):
+        # the hot loop reassigns the cache every call, so without
+        # donation each step/join copies the whole [L,...] pair and
+        # doubles peak cache memory.  CPU ignores donation with a
+        # warning per call site -- skip it there to keep test logs quiet.
         donate = jax.default_backend() != "cpu"
-        self._join = jax.jit(type(model).cache_join,
-                             donate_argnums=(0,) if donate else ())
+        self._donate = donate
 
-        def step_tokens(p, c, t, pos):
-            # argmax INSIDE the compiled step: the engine's lifecycle
-            # stays exactly three programs (compile-guard asserts it),
-            # and the per-step device->host transfer is [B] tokens
-            # instead of [B, vocab] logits
-            logits, cache = model.decode_step_rows(p, c, t, pos)
-            return jax.numpy.argmax(logits, -1).astype(jax.numpy.int32), \
-                cache
+        # -- speculative lane ------------------------------------------ #
+        self.draft_model = draft_model
+        self.draft_params = None
+        self.spec_k = int(spec_k)
+        if draft_model is not None:
+            if not self.paged:
+                raise ValueError("the speculative lane needs the paged "
+                                 "engine (its chunk scorer drafts into "
+                                 "scratch blocks); pass paged=True")
+            if spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if draft_model.cfg.sliding_window is not None:
+                raise ValueError("speculative decoding needs a linear "
+                                 "draft cache (sliding_window "
+                                 "unsupported)")
+            if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.cfg.vocab_size} != target "
+                    f"vocab {model.cfg.vocab_size}")
+            self.draft_params = jax.tree.map(jax.numpy.asarray,
+                                             draft_params)
+            from ..models.speculative import build_draft_proposer
+            self._d_propose = build_draft_proposer(
+                draft_model, self.draft_params, self.spec_k)
 
-        self._step = jax.jit(step_tokens,
-                             donate_argnums=(1,) if donate else ())
-        self._prefills: Dict[int, Any] = {}
-        self._cache = None
+        if self.paged:
+            self.block_len = int(block_len)
+            if self.block_len < 1:
+                raise ValueError("block_len must be >= 1")
+            headroom = self.spec_k if draft_model is not None else 0
+            self.max_blocks_per_slot = -(-(W + headroom) // self.block_len)
+            if n_blocks is None:
+                # capacity parity with the dense allocator by default:
+                # the HBM win comes from sizing the pool BELOW this
+                n_blocks = max_slots * self.max_blocks_per_slot + 1
+            if n_blocks < self.max_blocks_per_slot + 1:
+                raise ValueError(
+                    f"n_blocks {n_blocks} cannot hold even one full "
+                    f"request ({self.max_blocks_per_slot} blocks + the "
+                    "reserved garbage block)")
+            self.n_blocks = int(n_blocks)
+            if draft_model is not None:
+                # the draft's FIXED dense cache must cover every padded
+                # prompt bucket + drafting headroom (one program per
+                # bucket; block rounding may admit prompts past W)
+                self._draft_cache_len = (self.max_blocks_per_slot
+                                         * self.block_len + self.spec_k)
+                if draft_model.cfg.max_seq_len < self._draft_cache_len:
+                    raise ValueError(
+                        f"draft max_seq_len "
+                        f"{draft_model.cfg.max_seq_len} < the engine's "
+                        f"block-table span + spec_k "
+                        f"({self._draft_cache_len})")
+            self.prefix_cache = bool(prefix_cache)
+            self.allocator = BlockAllocator(self.n_blocks, self.block_len)
+            self.prompt_block = self.block_len  # buckets = block multiples
+            self.batcher = AdmissionController(
+                queue_depth=queue_depth,
+                max_new_tokens_cap=max_new_tokens_cap,
+                block_len=self.block_len,
+                pool_blocks=self.n_blocks - 1,
+                max_blocks_per_slot=self.max_blocks_per_slot,
+                spec_headroom=headroom,
+                pool_overcommit=pool_overcommit,
+                hard_total_cap=model.cfg.max_seq_len)
+            self._tables = np.zeros(
+                (max_slots, self.max_blocks_per_slot), np.int32)
+            self.metrics.bind_pool(self._pool_gauges)
+
+            def step_tokens(p, pool, tables, t, pos):
+                # argmax INSIDE the compiled step (compile-guard pins the
+                # program count); D2H per step is [B] tokens
+                logits, pool = model.decode_step_rows_paged(
+                    p, pool, tables, t, pos)
+                return jax.numpy.argmax(logits, -1).astype(
+                    jax.numpy.int32), pool
+
+            self._step = jax.jit(step_tokens,
+                                 donate_argnums=(1,) if donate else ())
+        else:
+            self.prompt_block = max(1, prompt_block)
+            self.batcher = AdmissionController(
+                queue_depth=queue_depth, max_total_len=W,
+                max_new_tokens_cap=max_new_tokens_cap)
+            self._join = jax.jit(type(model).cache_join,
+                                 donate_argnums=(0,) if donate else ())
+
+            def step_tokens(p, c, t, pos):
+                logits, cache = model.decode_step_rows(p, c, t, pos)
+                return jax.numpy.argmax(logits, -1).astype(
+                    jax.numpy.int32), cache
+
+            self._step = jax.jit(step_tokens,
+                                 donate_argnums=(1,) if donate else ())
+        self.metrics.bind_queue(lambda: self.batcher.depth)
+        self._prefills: Dict[Any, Any] = {}
+        self._cache = None          # dense cache OR paged pool
+        self._pool_bytes = 0        # measured placed pool bytes (paged)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._spec_active = 0
         self._stop = threading.Event()
         self._cancel_active = False
         self._thread: Optional[threading.Thread] = None
+        # mesh mutation LAST, after every validation that can raise: a
+        # failed construction must not hand the caller back a model
+        # silently stripped of its training mesh.  Decode runs
+        # replicated, exactly like generate() — a training-time mesh
+        # must not carve up step-sized activations (jit tracing is lazy,
+        # so nulling here still precedes every trace).
+        self._mesh_saved, model.mesh = model.mesh, None
+        if draft_model is not None:
+            self._draft_mesh_saved = draft_model.mesh
+            draft_model.mesh = None
 
     # ------------------------------------------------------------------ #
     # Lifecycle                                                          #
@@ -144,8 +392,16 @@ class ServeEngine:
     def start(self) -> "ServeEngine":
         if self._thread is not None:
             raise RuntimeError("engine already started")
-        self._cache = self.model.decode_cache_alloc(self.max_slots,
-                                                    self.max_total_len)
+        if self.paged:
+            self._cache = self.model.paged_cache_alloc(self.n_blocks,
+                                                       self.block_len)
+        else:
+            self._cache = self.model.decode_cache_alloc(
+                self.max_slots, self.max_total_len)
+        # placed-bytes truth for the waste-ratio gauges (and the probe's
+        # dense baseline): the real arrays' nbytes, not a formula
+        self._pool_bytes = int(self._cache["k"].nbytes
+                               + self._cache["v"].nbytes)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="rla-tpu-serve-engine")
         self._thread.start()
@@ -167,6 +423,8 @@ class ServeEngine:
         if n:
             self.metrics.inc("cancelled", n)
         self.model.mesh = self._mesh_saved
+        if self.draft_model is not None:
+            self.draft_model.mesh = self._draft_mesh_saved
 
     def __enter__(self) -> "ServeEngine":
         return self.start()
@@ -177,13 +435,27 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
     # Client surface                                                     #
     # ------------------------------------------------------------------ #
-    def submit(self, prompt: Any, max_new_tokens: int) -> ServeResponse:
-        """Admit a request (typed QueueFull/RequestRejected backpressure);
-        the response resolves to prompt + greedily generated tokens,
-        token-identical to ``generate()``."""
-        from .batcher import QueueFull, RequestRejected
+    def submit(self, prompt: Any, max_new_tokens: int,
+               speculative: bool = False) -> ServeResponse:
+        """Admit a request (typed QueueFull/PoolExhausted/RequestRejected
+        backpressure); the response resolves to prompt + greedily
+        generated tokens, token-identical to ``generate()``.
+        ``speculative=True`` hints the engine to route this single-stream
+        request through the speculative lane when it is idle (needs a
+        draft model; a busy engine uses a normal slot)."""
+        from .batcher import PoolExhausted, QueueFull, RequestRejected
+        if speculative and self.draft_model is None:
+            self.metrics.inc("rejected")  # typed rejections all count
+            raise RequestRejected(
+                "speculative routing needs a draft model: construct the "
+                "engine with draft_model=/draft_params=")
         try:
-            resp = self.batcher.submit(prompt, max_new_tokens)
+            resp = self.batcher.submit(prompt, max_new_tokens,
+                                       speculative=speculative)
+        except PoolExhausted:
+            self.metrics.inc("rejected")
+            self.metrics.inc("pool_exhausted")
+            raise
         except (QueueFull, RequestRejected):
             # admission rejections only: a ServeCancelled from a stopping
             # engine must not read as overload in the counters
@@ -199,6 +471,38 @@ class ServeEngine:
 
     def stats(self) -> Dict[str, Any]:
         return self.metrics.snapshot()
+
+    # ------------------------------------------------------------------ #
+    # Pool gauges (paged)                                                #
+    # ------------------------------------------------------------------ #
+    def _pool_gauges(self) -> Dict[str, Any]:
+        """Live block-pool occupancy + HBM truth for the metrics
+        snapshot.  ``dense_equivalent_bytes`` is what the PR 2 dense
+        allocator would pin for the SAME live sequences (one full
+        max-length row each); ``cache_waste_ratio`` is the fraction of
+        that the paged layout avoids."""
+        st = self.allocator.stats()
+        per_block = (self._pool_bytes / self.n_blocks
+                     if self._pool_bytes else 0.0)
+        row_bytes = per_block * self.max_blocks_per_slot
+        active = sum(1 for s in self._slots if s is not None) \
+            + self._spec_active
+        used_bytes = st["used"] * per_block
+        dense_eq = active * row_bytes
+        return {
+            "block_pool_total": st["total"],
+            "block_pool_used": st["used"],
+            "block_pool_cached": st["cached"],
+            "block_pool_free": st["free"],
+            "block_pool_occupancy": (st["used"] / st["total"]
+                                     if st["total"] else 0.0),
+            "block_len": self.block_len,
+            "hbm_cache_bytes": self._pool_bytes,
+            "hbm_used_bytes": int(used_bytes),
+            "dense_equivalent_bytes": int(dense_eq),
+            "cache_waste_ratio": (1.0 - used_bytes / dense_eq
+                                  if dense_eq > 0 else 0.0),
+        }
 
     # ------------------------------------------------------------------ #
     # Driver loop                                                        #
@@ -222,8 +526,10 @@ class ServeEngine:
         except BaseException as e:  # engine death must fail loudly, typed
             log.error("serve engine loop died: %s", e)
             for i, s in enumerate(self._slots):
-                if s is not None and s.resp._fail(e):
-                    self.metrics.inc("failed")
+                if s is not None:
+                    if s.resp._fail(e):
+                        self.metrics.inc("failed")
+                    self._release_request(s.req, s.blocks)
                 self._slots[i] = None
             n = self.batcher.shutdown()
             if n:  # keep completed+failed+cancelled == submitted honest
@@ -234,8 +540,11 @@ class ServeEngine:
         b = self.prompt_block
         return min(-(-s0 // b) * b, self.max_total_len)
 
+    # -- compiled-program memos ---------------------------------------- #
     def _prefill_fn(self, padded_len: int):
-        if padded_len not in self._prefills:
+        """Dense bucketed pad-prefill (paged=False)."""
+        key = ("dense", padded_len)
+        if key not in self._prefills:
             jax, model = self._jax, self.model
             jnp = jax.numpy
 
@@ -249,13 +558,142 @@ class ServeEngine:
             # memoized per prompt bucket: each padded length compiles
             # exactly once for the engine's lifetime, bounded by
             # max_total_len / prompt_block buckets
-            self._prefills[padded_len] = jax.jit(fn)  # graftlint: ok(retrace) — memoized per bucket
-        return self._prefills[padded_len]
+            self._prefills[key] = jax.jit(fn)  # graftlint: ok(retrace) — memoized per bucket
+        return self._prefills[key]
 
+    def _chunk_prefill_fn(self, padded_len: int):
+        """Paged chunk prefill per suffix-length bucket: run the padded
+        un-shared suffix at its true positions through the block table,
+        return the first greedy token.  The pool operand is donated; the
+        block table and start position are traced, so prefix hits of any
+        depth reuse one program per bucket."""
+        key = ("chunk", padded_len)
+        if key not in self._prefills:
+            jax, model = self._jax, self.model
+            jnp = jax.numpy
+
+            def fn(params, pool, table, tokens, pos0, last_rel):
+                logits, pool = model.decode_chunk_paged(
+                    params, pool, table, tokens, pos0,
+                    last_index=last_rel)
+                return jnp.argmax(logits, -1).astype(jnp.int32), pool
+
+            self._prefills[key] = jax.jit(  # graftlint: ok(retrace) — memoized per bucket
+                fn, donate_argnums=(1,) if self._donate else ())
+        return self._prefills[key]
+
+    def _spec_score_fn(self):
+        """Speculative chunk scorer (one program: spec_k is static):
+        feed [last, d_1..d_{k-1}] at pos..pos+k-1, return the target's
+        greedy token per position."""
+        key = ("spec", self.spec_k)
+        if key not in self._prefills:
+            jax, model = self._jax, self.model
+            jnp = jax.numpy
+
+            def fn(params, pool, table, chunk, pos0):
+                logits, pool = model.decode_chunk_paged(
+                    params, pool, table, chunk, pos0)
+                return jnp.argmax(logits[0], -1).astype(jnp.int32), pool
+
+            self._prefills[key] = jax.jit(  # graftlint: ok(retrace) — memoized once (spec_k static)
+                fn, donate_argnums=(1,) if self._donate else ())
+        return self._prefills[key]
+
+    def _draft_prefill_fn(self, padded_len: int):
+        """Draft-model bucketed pad-prefill into a FIXED-length dense
+        cache (max_total_len + spec_k), so every speculative request
+        shares one program per prompt bucket."""
+        key = ("draft", padded_len)
+        if key not in self._prefills:
+            jax, draft = self._jax, self.draft_model
+            cache_len = self._draft_cache_len
+
+            def fn(dparams, tokens, last_index):
+                _, cache = draft._prefill(dparams, tokens, cache_len,
+                                          last_index=last_index)
+                return cache
+
+            self._prefills[key] = jax.jit(fn)  # graftlint: ok(retrace) — memoized per bucket
+        return self._prefills[key]
+
+    # -- block bookkeeping ---------------------------------------------- #
+    def _prefix_keys(self, prompt: np.ndarray) -> List[str]:
+        """Chain hashes of the prompt's FULL blocks: key j commits to
+        tokens [0, (j+1)*block_len) — a hit therefore guarantees the
+        whole prefix matches, which is what makes the cached k/v exact
+        for the new request."""
+        bl = self.block_len
+        n_full = int(prompt.size) // bl
+        keys: List[str] = []
+        h = hashlib.blake2b(digest_size=16)
+        for j in range(n_full):
+            h.update(prompt[j * bl:(j + 1) * bl].tobytes())
+            keys.append(h.hexdigest())
+        return keys
+
+    def _release_request(self, req: ServeRequest,
+                         blocks: List[int]) -> None:
+        """Return a request's blocks (refcounted) and its admission-time
+        reservation; exactly once per placed request."""
+        if self.paged:
+            for b in blocks:
+                self.allocator.release(b)
+        self.batcher.release_blocks(req)
+
+    def _observe_pool(self) -> None:
+        if self.paged:
+            st = self.allocator.stats()
+            active = sum(1 for s in self._slots if s is not None) \
+                + self._spec_active
+            self.metrics.observe_pool(st["used"], active)
+
+    def _place_blocks(self, req: ServeRequest
+                      ) -> Optional[Tuple[List[int], List[int],
+                                          List[str]]]:
+        """Prefix-lookup + allocate a request's remaining blocks.
+        Returns (blocks, shared, keys) or None when the pool cannot
+        place it right now (caller pushes the request back)."""
+        s0 = int(req.prompt.size)
+        needed = req.blocks_reserved or blocks_for_request(
+            s0, req.max_new_tokens, self.block_len,
+            self.spec_k if req.speculative else 0)
+        shared: List[int] = []
+        keys: List[str] = []
+        if self.prefix_cache:
+            keys = self._prefix_keys(req.prompt)
+            if keys:
+                self.metrics.inc("prefix_lookups")
+            # keep >= 1 suffix token: the last prompt position's hidden
+            # state must actually be computed to produce token 0
+            shared = self.allocator.lookup_run(keys,
+                                               (s0 - 1) // self.block_len)
+            if shared:
+                self.metrics.inc("prefix_hits")
+                self.metrics.inc("prefix_hit_blocks", len(shared))
+        fresh = self.allocator.alloc(needed - len(shared))
+        if fresh is None:
+            for b in shared:
+                self.allocator.release(b)
+            return None
+        return shared + fresh, shared, keys
+
+    def _register_prompt_blocks(self, req: ServeRequest,
+                                blocks: List[int], shared: List[int],
+                                keys: List[str]) -> None:
+        """Publish this prompt's newly computed FULL blocks for future
+        prefix hits (partial/pad blocks never register)."""
+        if not self.prefix_cache:
+            return
+        for j in range(len(shared), int(req.prompt.size)
+                       // self.block_len):
+            self.allocator.register(keys[j], blocks[j])
+
+    # -- admission ------------------------------------------------------ #
     def _admit(self) -> int:
-        """Fill free slots from the queue: pad-prefill each request, slot-
-        join its cache, record TTFT (the first token exists the moment
-        prefill returns)."""
+        """Fill free slots from the queue: prefill each request into its
+        cache (dense row-join or paged blocks), record TTFT (the first
+        token exists the moment prefill returns)."""
         jnp = self._jax.numpy
         admitted = 0
         for i in range(self.max_slots):
@@ -265,40 +703,125 @@ class ServeEngine:
             if item is None:
                 break
             req, resp = item
+            if self.paged and req.speculative \
+                    and self.draft_model is not None \
+                    and all(s is None for s in self._slots):
+                # idle engine: the single-stream latency lane
+                if not self._run_speculative(req, resp):
+                    break  # pool cannot place it now; request pushed back
+                admitted += 1
+                continue
+            if self.paged:
+                placed = self._place_blocks(req)
+                if placed is None:
+                    # pool exhausted right now: FIFO head waits (no
+                    # starvation; retires free blocks every step)
+                    self.batcher.push_front(item)
+                    break
+                blocks, shared, keys = placed
+            else:
+                blocks, shared, keys = None, (), ()
+            try:
+                self._admit_one(i, req, resp, blocks, shared, keys)
+            except BaseException as e:
+                # the popped request is in neither the queue nor a slot:
+                # its future must fail HERE or the client hangs until
+                # timeout while the loop dies loudly
+                if resp._fail(e):
+                    self.metrics.inc("failed")
+                if self.paged:
+                    self._release_request(req, blocks)
+                raise
+            admitted += 1
+        return admitted
+
+    def _paged_prefill(self, req: ServeRequest, resp: ServeResponse,
+                       blocks: List[int], shared, keys,
+                       slot: int, speculative: bool = False
+                       ) -> Tuple[int, np.ndarray, float]:
+        """The one paged prefill path (normal slots AND the speculative
+        lane ride it, so they cannot drift): build the request's table,
+        chunk-prefill the un-shared suffix into its blocks, register the
+        new full prompt blocks, and record TTFT.  Returns (first token,
+        table row, completion timestamp)."""
+        jnp = self._jax.numpy
+        t_a = time.monotonic()
+        start = len(shared) * self.block_len
+        sfx = req.prompt[start:]
+        P = -(-int(sfx.size) // self.block_len) * self.block_len
+        padded = np.zeros((1, P), np.int32)
+        padded[0, :sfx.size] = sfx
+        table = np.zeros((self.max_blocks_per_slot,), np.int32)
+        table[:len(blocks)] = blocks
+        tok0, self._cache = self._chunk_prefill_fn(P)(
+            self.params, self._cache, jnp.asarray(table),
+            jnp.asarray(padded), jnp.int32(start),
+            jnp.int32(int(sfx.size) - 1))
+        self._register_prompt_blocks(req, blocks, shared, keys)
+        # graftlint: ok(host-sync) — TTFT gate: the first token must
+        first = int(np.asarray(tok0)[0])  # be real before it is timed
+        now = time.monotonic()
+        resp.ttft_s = now - req.t_submit
+        self.metrics.observe_ttft(resp.ttft_s)
+        self.metrics.observe_prefill(now - t_a)
+        telemetry.emit("serve_prefill", trace=req.trace_id,
+                       request=req.request_id, bucket=P, slot=slot,
+                       shared_blocks=len(shared),
+                       speculative=speculative,
+                       ttft_ms=round(resp.ttft_s * 1e3, 3))
+        return first, table, now
+
+    def _admit_one(self, i: int, req: ServeRequest, resp: ServeResponse,
+                   blocks: Optional[List[int]], shared, keys) -> None:
+        """Prefill one placed request into slot ``i`` (or finish it at
+        prefill for single-token budgets)."""
+        jnp = self._jax.numpy
+        s0 = int(req.prompt.size)
+        if self.paged:
+            first, table, now = self._paged_prefill(req, resp, blocks,
+                                                    shared, keys, slot=i)
+        else:
             t_a = time.monotonic()
-            s0 = int(req.prompt.size)
             P = self._bucket(s0)
             padded = np.zeros((1, P), np.int32)
             padded[0, :s0] = req.prompt
             tok0, row_cache = self._prefill_fn(P)(
                 self.params, jnp.asarray(padded), jnp.int32(s0 - 1))
             if req.max_new_tokens > 1:
-                # single-token requests finish at prefill; joining their
-                # row would copy the whole multi-slot cache for nothing
+                # single-token requests finish at prefill; joining
+                # their row would copy the whole cache for nothing
                 self._cache = self._join(self._cache, row_cache,
                                          jnp.int32(i))
             # graftlint: ok(host-sync) — TTFT gate: the first token must
-            first = int(np.asarray(tok0)[0])  # be real before it is timed
+            first = int(np.asarray(tok0)[0])  # be real before timing
             now = time.monotonic()
             resp.ttft_s = now - req.t_submit
             self.metrics.observe_ttft(resp.ttft_s)
             self.metrics.observe_prefill(now - t_a)
             telemetry.emit("serve_prefill", trace=req.trace_id,
                            request=req.request_id, bucket=P, slot=i,
+                           shared_blocks=0,
                            ttft_ms=round(resp.ttft_s * 1e3, 3))
-            if req.max_new_tokens == 1:
-                self._finish(req, resp, [first])
-            else:
-                self._slots[i] = _Slot(req, resp, pos=s0,
-                                       first_token=first, t_now=now)
-            admitted += 1
-        return admitted
+        if req.max_new_tokens == 1:
+            self._finish(req, resp, [first])
+            if self.paged:
+                self._release_request(req, blocks)
+        else:
+            slot = _Slot(req, resp, pos=s0, first_token=first,
+                         t_now=now,
+                         blocks=blocks if self.paged else None)
+            self._slots[i] = slot
+            if self.paged:
+                self._tables[i, :] = table
+        self._observe_pool()
 
+    # -- decode --------------------------------------------------------- #
     def _decode_step(self, active: List[int]) -> None:
         """One batched step over ALL slots (static shape); only active
-        rows advance host-side.  Inactive rows feed token 0 at position 0
-        — their slot is rewritten by the next join before the causal mask
-        can expose the garbage."""
+        rows advance host-side.  Inactive rows feed token 0 at position
+        0 — dense: their slot is rewritten by the next join before the
+        causal mask can expose the garbage; paged: their all-zero table
+        routes the write to the reserved garbage block."""
         jnp = self._jax.numpy
         toks = np.zeros((self.max_slots,), np.int32)
         poss = np.zeros((self.max_slots,), np.int32)
@@ -307,9 +830,14 @@ class ServeEngine:
             toks[i] = s.last
             poss[i] = s.pos
         t0 = time.monotonic()
-        toks_next, self._cache = self._step(self.params, self._cache,
-                                            jnp.asarray(toks),
-                                            jnp.asarray(poss))
+        if self.paged:
+            toks_next, self._cache = self._step(
+                self.params, self._cache, jnp.asarray(self._tables),
+                jnp.asarray(toks), jnp.asarray(poss))
+        else:
+            toks_next, self._cache = self._step(self.params, self._cache,
+                                                jnp.asarray(toks),
+                                                jnp.asarray(poss))
         # deliberate: step k+1's input IS step k's output, so the loop
         # must materialize it — the one sync a greedy feed cannot avoid
         nxt = np.asarray(toks_next)  # graftlint: ok(host-sync) — feed gate
@@ -319,6 +847,7 @@ class ServeEngine:
         # lives in the admit/prefill/respond events' traces
         telemetry.emit("serve_decode_step", active=len(active),
                        step_ms=round((now - t0) * 1e3, 3))
+        retired = False
         for i in active:
             s = self._slots[i]
             tok = int(nxt[i])
@@ -330,7 +859,119 @@ class ServeEngine:
             s.t_last = now
             if s.remaining <= 0:
                 self._finish(s.req, s.resp, s.generated)
-                self._slots[i] = None  # retire = host-side slot free
+                if self.paged:
+                    self._release_request(s.req, s.blocks)
+                    self._tables[i, :] = 0
+                self._slots[i] = None  # retire = host-side table write
+                retired = True
+        if retired:
+            self._observe_pool()
+
+    # -- speculative lane ------------------------------------------------ #
+    def _run_speculative(self, req: ServeRequest,
+                         resp: ServeResponse) -> bool:
+        """Serve one single-stream request end-to-end through greedy
+        speculative decode against the PAGED pool: paged chunk prefill
+        (prefix hits included), then rounds of draft-propose / one-pass
+        target verification whose chunk writes land in the request's
+        pre-reserved scratch blocks.  Rejected positions are rewritten
+        by later rounds before the mask can expose them (the linear-
+        cache no-rollback argument).  Returns False when the pool cannot
+        place the request right now (request pushed back, nothing
+        consumed)."""
+        jnp = self._jax.numpy
+        placed = self._place_blocks(req)
+        if placed is None:
+            self.batcher.push_front((req, resp))
+            return False
+        blocks, shared, keys = placed
+        self._spec_active = 1
+        try:
+            try:
+                self._spec_decode(req, resp, blocks, shared, keys)
+            except BaseException as e:
+                # the request is in neither the queue nor a slot: fail
+                # its future here or the client hangs until timeout
+                if resp._fail(e):
+                    self.metrics.inc("failed")
+                raise
+        finally:
+            self._spec_active = 0
+            self._release_request(req, blocks)
+            self._observe_pool()
+        return True
+
+    def _spec_decode(self, req: ServeRequest, resp: ServeResponse,
+                     blocks: List[int], shared, keys) -> None:
+        jnp = self._jax.numpy
+        s0 = int(req.prompt.size)
+        first, table, now = self._paged_prefill(req, resp, blocks,
+                                                shared, keys, slot=-1,
+                                                speculative=True)
+        table_j = jnp.asarray(table)
+        self.metrics.inc("speculative_requests")
+        self._observe_pool()
+        out = [first]
+        if req.max_new_tokens > 1:
+            # draft prefill: full padded prompt, fixed cache length.
+            # Bucket WITHOUT the dense max_total_len clamp: block
+            # rounding may admit prompts past W (the table span covers
+            # them; the admission hard cap bounds them by max_seq_len)
+            PB = -(-s0 // self.block_len) * self.block_len
+            dpad = np.zeros((1, PB), np.int32)
+            dpad[0, :s0] = req.prompt
+            d_cache = self._draft_prefill_fn(PB)(
+                self.draft_params, jnp.asarray(dpad),
+                jnp.int32(s0 - 1))
+            score = self._spec_score_fn()
+            k = self.spec_k
+            mx = req.max_new_tokens
+            t_last_tok = now
+            while len(out) < mx:
+                if self._stop.is_set() and self._cancel_active:
+                    # fast teardown must be able to interrupt the lane
+                    # mid-request, exactly like _cancel_slots does for
+                    # slot decodes
+                    if resp._fail(ServeCancelled(
+                            f"request {req.request_id} cancelled "
+                            "mid-speculative-decode: engine stopped "
+                            "with cancel_active")):
+                        self.metrics.inc("cancelled")
+                    return
+                pos = s0 + len(out) - 1  # newest real token's slot
+                last = jnp.asarray([out[-1]], jnp.int32)
+                d_cache, draft_toks = self._d_propose(
+                    d_cache, last, jnp.asarray(pos))
+                # the next round's feed depends on these tokens
+                # graftlint: ok(host-sync) — accept gate
+                drafts = [int(t) for t in np.asarray(draft_toks)]
+                chunk = jnp.asarray([[out[-1]] + drafts[:-1]],
+                                    jnp.int32)
+                t0 = time.monotonic()
+                greedy_arr, self._cache = score(
+                    self.params, self._cache, table_j, chunk,
+                    jnp.int32(pos))
+                # graftlint: ok(host-sync) — accept gate
+                greedy = np.asarray(greedy_arr)
+                accept = 0
+                while accept < k and greedy[accept] == drafts[accept] \
+                        and len(out) + accept + 1 < mx:
+                    accept += 1
+                self.metrics.inc("speculative_tokens_accepted",
+                                 accept)
+                new = drafts[:accept] + [int(greedy[accept])] \
+                    if accept < k else drafts[:accept]
+                new = new[:mx - len(out)]
+                now = time.monotonic()
+                self.metrics.observe_spec_round(now - t0, len(new))
+                # per-token latency: the round produced len(new)
+                # tokens in one target pass — amortize honestly
+                dt_tok = (now - t_last_tok) / max(1, len(new))
+                for _ in new:
+                    self.metrics.observe_token_latency(dt_tok)
+                t_last_tok = now
+                out.extend(new)
+        self._finish(req, resp, out)
 
     def _finish(self, req: ServeRequest, resp: ServeResponse,
                 generated: List[int]) -> None:
@@ -350,4 +991,7 @@ class ServeEngine:
                     f"request {s.req.request_id} cancelled mid-decode: "
                     "engine stopped with cancel_active")):
                 self.metrics.inc("cancelled")
+            self._release_request(s.req, s.blocks)
+            if self.paged:
+                self._tables[i, :] = 0
             self._slots[i] = None

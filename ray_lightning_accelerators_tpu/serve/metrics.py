@@ -40,21 +40,54 @@ class ServeMetrics:
 
     _COUNTERS = ("submitted", "completed", "failed", "cancelled",
                  "rejected", "requeued", "prefills", "tokens_generated",
-                 "steps", "steps_batch_gt1", "wedge_events")
+                 "steps", "steps_batch_gt1", "wedge_events",
+                 "pool_exhausted", "prefix_lookups", "prefix_hits",
+                 "prefix_hit_blocks", "speculative_requests",
+                 "speculative_rounds", "speculative_tokens_accepted")
+
+    # pool/HBM fields are GAUGES (live values, not monotone counters);
+    # telemetry/registry.py keys its Prometheus type choice off this set
+    POOL_GAUGES = ("block_pool_total", "block_pool_used",
+                   "block_pool_cached", "block_pool_free",
+                   "block_pool_occupancy", "block_len",
+                   "hbm_cache_bytes", "hbm_used_bytes",
+                   "dense_equivalent_bytes", "cache_waste_ratio",
+                   "peak_used_blocks", "peak_concurrent")
 
     def __init__(self, profiler: Optional[Profiler] = None):
         self.profiler = profiler or Profiler()
         self._lock = threading.Lock()
         self._c: Dict[str, int] = {k: 0 for k in self._COUNTERS}
         self._max_batch = 0
+        self._peak_used_blocks = 0
+        self._peak_concurrent = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self._queue_depth: Callable[[], int] = lambda: 0
+        self._pool_gauges: Optional[Callable[[], Dict[str, Any]]] = None
 
     # ------------------------------------------------------------------ #
     def bind_queue(self, depth_fn: Callable[[], int]) -> None:
         """Wire the live queue-depth gauge (the batcher owns the number)."""
         self._queue_depth = depth_fn
+
+    def bind_pool(self, gauges_fn: Callable[[], Dict[str, Any]]) -> None:
+        """Wire the paged engine's live block-pool gauges: a callable
+        returning flat numeric fields (``block_pool_*`` occupancy,
+        ``hbm_cache_bytes``, ``dense_equivalent_bytes``,
+        ``cache_waste_ratio``) merged into every snapshot.  Dense
+        engines never bind, and the fields stay absent."""
+        self._pool_gauges = gauges_fn
+
+    def observe_pool(self, used_blocks: int, concurrent: int) -> None:
+        """Record a pool-occupancy observation (engine calls at every
+        admit/retire): high-watermarks survive in the snapshot so probes
+        can report PEAK placed sequences/blocks, not just the final
+        drained state."""
+        with self._lock:
+            self._peak_used_blocks = max(self._peak_used_blocks,
+                                         used_blocks)
+            self._peak_concurrent = max(self._peak_concurrent, concurrent)
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -75,6 +108,20 @@ class ServeMetrics:
         with self._lock:
             self._c["prefills"] += 1
             self._c["tokens_generated"] += 1
+            if self._t_first is None:
+                self._t_first = now - dt_s
+            self._t_last = now
+
+    def observe_spec_round(self, dt_s: float, tokens: int) -> None:
+        """One speculative draft/verify round that emitted ``tokens``
+        accepted+corrected tokens in one target pass: extends the busy
+        window and the token count (throughput stays honest), counted
+        under ``speculative_rounds`` rather than ``steps``."""
+        self.profiler.observe(self.STEP, dt_s)
+        now = time.monotonic()
+        with self._lock:
+            self._c["speculative_rounds"] += 1
+            self._c["tokens_generated"] += tokens
             if self._t_first is None:
                 self._t_first = now - dt_s
             self._t_last = now
@@ -114,6 +161,8 @@ class ServeMetrics:
         with self._lock:
             counters = dict(self._c)
             max_batch = self._max_batch
+            peak_used = self._peak_used_blocks
+            peak_conc = self._peak_concurrent
             busy_s = ((self._t_last - self._t_first)
                       if self._t_first is not None
                       and self._t_last is not None else 0.0)
@@ -121,6 +170,10 @@ class ServeMetrics:
         out["max_batch"] = max_batch
         out["queue_depth"] = self._queue_depth()
         out["busy_s"] = busy_s
+        if self._pool_gauges is not None:
+            out.update(self._pool_gauges())
+            out["peak_used_blocks"] = peak_used
+            out["peak_concurrent"] = peak_conc
         out["throughput_tok_s"] = (
             counters["tokens_generated"] / busy_s if busy_s > 0 else 0.0)
         out["ttft_s"] = pct(self.TTFT)
@@ -140,6 +193,8 @@ class ServeMetrics:
         with self._lock:
             self._c = {k: 0 for k in self._COUNTERS}
             self._max_batch = 0
+            self._peak_used_blocks = 0
+            self._peak_concurrent = 0
             self._t_first = None
             self._t_last = None
         self.profiler.reset()

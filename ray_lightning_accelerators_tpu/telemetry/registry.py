@@ -197,9 +197,12 @@ class MetricsRegistry:
         serve_keys = sorted({k for snap in self._serve.values()
                              for k, v in snap.items()
                              if isinstance(v, (int, float))})
+        # lazy import: serve/__init__ imports telemetry.recorder, so a
+        # module-level import here would cycle through the packages
+        from ..serve.metrics import ServeMetrics as _SM
         for key in serve_keys:
             gauge = key in ("queue_depth", "busy_s", "throughput_tok_s",
-                            "max_batch")
+                            "max_batch") or key in _SM.POOL_GAUGES
             name = f"rla_tpu_serve_{_prom_name(key)}"
             if not gauge:
                 name = f"{name}_total"

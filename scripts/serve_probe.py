@@ -11,6 +11,16 @@ produces a parseable record.
 Usage::
 
     python scripts/serve_probe.py [--requests N] [--slots S] [--seed K]
+        [--workload uniform|mixed] [--shared-prefix L]
+
+``--workload mixed`` swaps the uniform 4..31-token prompts for a
+production-shaped LOGNORMAL prompt-length distribution (most prompts
+short, a heavy tail near the budget), reporting the paged pool's
+measured cache-waste ratio next to TTFT/p99.  ``--shared-prefix L``
+additionally prepends one shared L-token system prompt to every request
+— the prefix-reuse mode: full blocks of the shared prefix are mapped
+copy-on-write from the LRU prefix index instead of re-prefilled, and
+the record carries the hit counters.
 
 Output (compile-count line, telemetry line, metric line LAST)::
 
@@ -47,7 +57,35 @@ def _arg(flag: str, default: int) -> int:
     return default
 
 
-def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
+def _arg_str(flag: str, default: str) -> str:
+    if flag in sys.argv:
+        return sys.argv[sys.argv.index(flag) + 1]
+    return default
+
+
+def mixed_prompts(rng, n, vocab, max_len, shared=None):
+    """Production-shaped prompt lengths: lognormal (most prompts short,
+    a heavy tail toward the budget), clipped to ``max_len``; with
+    ``shared`` every prompt is that system prompt + a short unique
+    suffix (the prefix-reuse traffic shape)."""
+    import numpy as np
+    out = []
+    for _ in range(n):
+        if shared is not None:
+            sfx = int(rng.integers(2, 17))
+            p = np.concatenate([
+                shared,
+                rng.integers(0, vocab, size=(sfx,)).astype(np.int32)])
+        else:
+            ln = int(np.clip(np.round(rng.lognormal(np.log(16.0), 0.8)),
+                             2, max_len))
+            p = rng.integers(0, vocab, size=(ln,)).astype(np.int32)
+        out.append(p)
+    return out
+
+
+def probe(n_requests: int, max_slots: int, seed: int,
+          workload: str = "uniform", shared_prefix: int = 0) -> tuple:
     import jax
     import numpy as np
 
@@ -63,8 +101,14 @@ def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
     model = GPT(cfg)
     params = model.init_params(jax.random.PRNGKey(seed))
     rng = np.random.default_rng(seed)
+    shared = (rng.integers(0, cfg.vocab_size,
+                           size=(shared_prefix,)).astype(np.int32)
+              if shared_prefix else None)
 
     def prompts(n):
+        if workload == "mixed":
+            return mixed_prompts(rng, n, cfg.vocab_size, 64,
+                                 shared=shared)
         return [rng.integers(0, cfg.vocab_size,
                              size=(int(rng.integers(4, 32)),)
                              ).astype(np.int32) for _ in range(n)]
@@ -72,15 +116,24 @@ def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
     with ServeEngine(model, params, max_slots=max_slots,
                      queue_depth=max(64, 2 * n_requests)) as engine:
         # warmup: touch EVERY prompt-length bucket the measured window
-        # can hit (lengths 4..31 -> one prompt per prompt_block bucket)
-        # plus the join/step programs, so the window bills decode, not
-        # XLA compiles
+        # can hit, plus the join/step programs, so the window bills
+        # decode, not XLA compiles
         blk = engine.prompt_block
-        for s0 in range(blk, 33, blk):
+        top = 65 if workload == "mixed" else 33
+        for s0 in range(blk, top, blk):
             p = rng.integers(0, cfg.vocab_size,
                              size=(max(1, s0 - 1),)).astype(np.int32)
             engine.submit(p, 2).result(timeout=600)
-        engine.metrics.profiler.reset()
+        if shared is not None:
+            # shared-prefix mode additionally hits SUFFIX buckets: a
+            # first request seeds the prefix index, a second (per suffix
+            # bucket edge) compiles the hit path's chunk program
+            for sfx in (2, 16):
+                for _ in range(2):
+                    p = np.concatenate([shared, rng.integers(
+                        0, cfg.vocab_size, size=(sfx,)).astype(np.int32)])
+                    engine.submit(p, 2).result(timeout=600)
+        engine.metrics.reset()
         window_start = cg.compile_count()  # warmup done: window begins
 
         handles = [engine.submit(p, int(rng.integers(8, 33)))
@@ -103,8 +156,9 @@ def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
         row = snap.get(fam) or {}
         return round(1e3 * row.get(key, 0.0), 3)
 
-    return compile_rec, telemetry_rec, {
+    rec = {
         "probe": "serve", "requests": n_requests, "max_slots": max_slots,
+        "workload": workload, "shared_prefix": shared_prefix,
         "tokens_generated": snap["tokens_generated"],
         "busy_s": round(snap["busy_s"], 3),
         "throughput_tok_s": round(snap["throughput_tok_s"], 1),
@@ -118,13 +172,34 @@ def probe(n_requests: int, max_slots: int, seed: int) -> tuple:
         "steps_batch_gt1": snap["steps_batch_gt1"],
         "max_batch": snap["max_batch"],
     }
+    if "block_pool_total" in snap:  # paged engine: pool/prefix truth
+        peak_c = snap["peak_concurrent"]
+        peak_u = snap["peak_used_blocks"]
+        per_slot = engine.max_blocks_per_slot
+        rec.update({
+            "block_len": snap["block_len"],
+            "peak_concurrent": peak_c,
+            "peak_used_blocks": peak_u,
+            "hbm_cache_bytes": snap["hbm_cache_bytes"],
+            # measured waste the dense allocator would have carried for
+            # the peak concurrent set: blocks actually placed vs one
+            # full-budget row per live sequence
+            "cache_waste_ratio": round(
+                1.0 - peak_u / (peak_c * per_slot), 4)
+            if peak_c else 0.0,
+            "prefix_hits": snap["prefix_hits"],
+            "prefix_hit_blocks": snap["prefix_hit_blocks"],
+        })
+    return compile_rec, telemetry_rec, rec
 
 
 def main() -> None:
     compile_rec = telemetry_rec = None
     try:
         compile_rec, telemetry_rec, rec = probe(
-            _arg("--requests", 16), _arg("--slots", 4), _arg("--seed", 0))
+            _arg("--requests", 16), _arg("--slots", 4), _arg("--seed", 0),
+            workload=_arg_str("--workload", "uniform"),
+            shared_prefix=_arg("--shared-prefix", 0))
     except Exception as e:
         rec = {"probe": "serve",
                "error": f"{type(e).__name__}: {e}"[:400]}

@@ -513,11 +513,14 @@ def test_compile_guard_counts_and_budgets():
             raise RuntimeError("inner")
 
 
-def test_serve_engine_three_program_invariant():
-    """The PR 2 prose, enforced: a staggered join/retire workload over
-    one prompt bucket runs the engine's WHOLE lifecycle in exactly 3
-    compiled programs (bucket prefill, slot join, batched step), and a
-    second wave adds zero."""
+def test_serve_engine_program_count_invariant():
+    """The PR 2 prose, enforced through the paging indirection: a
+    staggered join/retire workload over one prompt bucket runs the
+    PAGED engine's whole lifecycle in exactly 2 compiled programs
+    (bucketed chunk prefill-into-blocks, batched paged step — the slot
+    join fused into prefill), the DENSE engine's in exactly 3 (bucket
+    prefill, slot join, batched step), and a second wave adds zero to
+    either."""
     from ray_lightning_accelerators_tpu.models.transformer import (
         GPT, TransformerConfig)
     from ray_lightning_accelerators_tpu.serve import ServeEngine
@@ -527,34 +530,40 @@ def test_serve_engine_three_program_invariant():
     model = GPT(cfg)
     params = model.init_params(jax.random.PRNGKey(3))
     rng = np.random.default_rng(11)
-    # one prompt bucket: lengths 3..8 all pad to prompt_block=8
+    # one prompt bucket: lengths 3..8 all pad to 8 (prompt_block and
+    # block_len both 8)
     reqs = [(rng.integers(0, 89, size=(int(rng.integers(3, 9)),))
              .astype(np.int32), int(rng.integers(4, 10)))
             for _ in range(6)]
-    eng = ServeEngine(model, params, max_slots=3, queue_depth=32)
-    eng.start()  # cache alloc outside the guard: it is not a program
-    try:
-        with compile_guard(max_new_compiles=3, label="serve-3prog") as g:
-            resps = []
-            for i, (p, n) in enumerate(reqs):
-                resps.append(eng.submit(p, n))
-                if i % 2 == 1:
-                    time.sleep(0.02)  # staggered: join/retire mid-flight
-            for r in resps:
-                r.result(timeout=300)
-        assert g.new_compiles == 3, (
-            f"expected exactly 3 compiled programs (prefill/join/step), "
-            f"got {g.new_compiles}")
-        # second wave: join + retire + decode reuse every program
-        with compile_guard(max_new_compiles=0, label="serve-steady"):
-            more = [eng.submit(p, n) for p, n in reqs[:3]]
-            for r in more:
-                r.result(timeout=300)
-    finally:
-        eng.stop()
-    snap = eng.stats()
-    assert snap["completed"] == 9
-    assert snap["steps_batch_gt1"] >= 1  # it genuinely batched
+    for paged, expected, what in (
+            (True, 2, "chunk prefill/step"),
+            (False, 3, "prefill/join/step")):
+        eng = ServeEngine(model, params, max_slots=3, queue_depth=32,
+                          paged=paged, block_len=8, prefix_cache=False)
+        eng.start()  # cache alloc outside the guard: it is not a program
+        try:
+            with compile_guard(max_new_compiles=expected,
+                               label="serve-prog") as g:
+                resps = []
+                for i, (p, n) in enumerate(reqs):
+                    resps.append(eng.submit(p, n))
+                    if i % 2 == 1:
+                        time.sleep(0.02)  # staggered: join/retire mid-flight
+                for r in resps:
+                    r.result(timeout=300)
+            assert g.new_compiles == expected, (
+                f"expected exactly {expected} compiled programs "
+                f"({what}, paged={paged}), got {g.new_compiles}")
+            # second wave: join + retire + decode reuse every program
+            with compile_guard(max_new_compiles=0, label="serve-steady"):
+                more = [eng.submit(p, n) for p, n in reqs[:3]]
+                for r in more:
+                    r.result(timeout=300)
+        finally:
+            eng.stop()
+        snap = eng.stats()
+        assert snap["completed"] == 9
+        assert snap["steps_batch_gt1"] >= 1  # it genuinely batched
 
 
 def test_trainer_no_retrace_after_warmup(tmpdir):

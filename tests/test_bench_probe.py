@@ -53,7 +53,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
                                                            capsys):
     """main() with a dead backend: the death record comes FIRST, no
     accelerator bench ever ran -- and the CPU-mesh fallback benches
-    (gradexchange + input_pipeline) still land REAL metric lines next
+    (gradexchange/input_pipeline/fsdp_exchange/paged_serve)
+    still land REAL metric lines next
     to the death record, so the window exits 0 and the driver records
     numbers (all five earlier BENCH rounds were rc=2 with zero real
     numbers; this pins the fix).  The fallbacks are faked here (the
@@ -80,18 +81,23 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_fsdp_exchange",
         lambda: {"metric": "fsdp_exchange_int8_wire_bytes_reduction",
                  "value": 2.65, "unit": "x", "vs_baseline": 1.0})
+    monkeypatch.setattr(
+        bench, "bench_paged_serve",
+        lambda: {"metric": "paged_serve_concurrency_per_hbm_ratio",
+                 "value": 3.9, "unit": "x", "vs_baseline": 2.6})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 4
+    assert len(lines) == 5
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
     assert lines[2]["metric"] == "input_pipeline_prefetch_speedup"
     assert lines[3]["metric"] == "fsdp_exchange_int8_wire_bytes_reduction"
+    assert lines[4]["metric"] == "paged_serve_concurrency_per_hbm_ratio"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -104,13 +110,16 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
               in capsys.readouterr().out.splitlines() if ln.strip()]
     assert [r["metric"] for r in lines2] == [
         "backend_probe", "input_pipeline_prefetch_speedup",
-        "fsdp_exchange_int8_wire_bytes_reduction"]
+        "fsdp_exchange_int8_wire_bytes_reduction",
+        "paged_serve_concurrency_per_hbm_ratio"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
     monkeypatch.setattr(bench, "bench_input_pipeline",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_fsdp_exchange",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_paged_serve",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -147,6 +156,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_fsdp_exchange",
         lambda: {"metric": "fsdp_exchange_int8_wire_bytes_reduction",
                  "value": 2.65, "unit": "x", "vs_baseline": 1.0})
+    monkeypatch.setattr(
+        bench, "bench_paged_serve",
+        lambda: {"metric": "paged_serve_concurrency_per_hbm_ratio",
+                 "value": 3.9, "unit": "x", "vs_baseline": 2.6})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -159,7 +172,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
     assert [r["metric"] for r in lines[1:]] == [
         "gradexchange_int8_wire_bytes_reduction",
         "input_pipeline_prefetch_speedup",
-        "fsdp_exchange_int8_wire_bytes_reduction"]
+        "fsdp_exchange_int8_wire_bytes_reduction",
+        "paged_serve_concurrency_per_hbm_ratio"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -256,6 +270,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_fsdp_exchange",
         lambda: {"metric": "fsdp_exchange_int8_wire_bytes_reduction",
                  "value": 2.65, "unit": "x", "vs_baseline": 1.0})
+    monkeypatch.setattr(
+        bench, "bench_paged_serve",
+        lambda: {"metric": "paged_serve_concurrency_per_hbm_ratio",
+                 "value": 3.9, "unit": "x", "vs_baseline": 2.6})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -268,6 +286,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "gradexchange_int8_wire_bytes_reduction" in metrics
     assert "input_pipeline_prefetch_speedup" in metrics
     assert "fsdp_exchange_int8_wire_bytes_reduction" in metrics
+    assert "paged_serve_concurrency_per_hbm_ratio" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

@@ -1,0 +1,443 @@
+"""Paged KV cache + prefix reuse + speculative serve lane: block
+allocator semantics, token-exactness through the block-table
+indirection (joins, retires, block growth, prefix hits, speculative
+routing), typed pool admission, and the new pool metrics.  All CPU,
+tier-1 fast."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.models.transformer import (
+    GPT, TransformerConfig)
+from ray_lightning_accelerators_tpu.serve import (BlockAllocator,
+                                                  PoolExhausted,
+                                                  QueueFull,
+                                                  RequestRejected,
+                                                  ServeEngine,
+                                                  blocks_for_request)
+
+pytestmark = [pytest.mark.serve, pytest.mark.paged]
+
+
+def _model(vocab=97, layers=2, max_seq_len=64, seed=0, d_model=64,
+           n_heads=2, d_ff=128):
+    cfg = TransformerConfig(vocab_size=vocab, d_model=d_model,
+                            n_heads=n_heads, d_ff=d_ff, n_layers=layers,
+                            max_seq_len=max_seq_len)
+    m = GPT(cfg)
+    return m, m.init_params(jax.random.PRNGKey(seed))
+
+
+def _refs(model, params, reqs):
+    return [np.asarray(model.generate(params, jnp.asarray(p[None]),
+                                      max_new_tokens=n))[0]
+            for p, n in reqs]
+
+
+# --------------------------------------------------------------------- #
+# BlockAllocator                                                        #
+# --------------------------------------------------------------------- #
+def test_block_allocator_alloc_release_refcount():
+    a = BlockAllocator(n_blocks=6, block_len=4)  # 5 usable (0 reserved)
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.stats() == {"total": 5, "used": 3, "cached": 0, "free": 2}
+    # exhaustion: no cached blocks to evict -> None, nothing consumed
+    assert a.alloc(3) is None
+    assert a.stats()["free"] == 2
+    for b in got:
+        a.release(b)
+    assert a.stats() == {"total": 5, "used": 0, "cached": 0, "free": 5}
+    assert len(a.alloc(5)) == 5
+
+
+def test_block_allocator_prefix_sharing_and_lru_eviction():
+    a = BlockAllocator(n_blocks=5, block_len=4)   # 4 usable
+    b1, b2 = a.alloc(2)
+    a.register("k1", b1)
+    a.register("k2", b2)
+    # a sharer retains the full run; a miss stops the run
+    run = a.lookup_run(["k1", "k2", "k-miss"], max_blocks=8)
+    assert run == [b1, b2]
+    assert a.stats()["used"] == 2
+    # owner releases: blocks stay used (the sharer holds them)
+    a.release(b1), a.release(b2)
+    assert a.stats()["used"] == 2
+    # sharer releases: registered blocks become CACHED, not free
+    a.release(b1), a.release(b2)
+    assert a.stats() == {"total": 4, "used": 0, "cached": 2, "free": 2}
+    # allocation pressure evicts the LRU cached block (k1 was refreshed
+    # to MRU by the lookup... both released; k1 was moved to end first,
+    # then k2 -> k1 is older? move_to_end order: k1 then k2 -> LRU = k1)
+    got = a.alloc(3)
+    assert len(got) == 3
+    st = a.stats()
+    assert st["cached"] == 1 and st["used"] == 3
+    # the surviving key still hits; the evicted one misses
+    hits = a.lookup_run(["k1"], max_blocks=8)
+    rem = a.lookup_run(["k2"], max_blocks=8)
+    assert (len(hits), len(rem)) in ((0, 1), (1, 0))  # exactly one left
+    # referenced cached blocks are never evicted
+    assert a.alloc(2) is None
+
+
+def test_block_allocator_first_registration_wins():
+    a = BlockAllocator(n_blocks=5, block_len=4)
+    b1, b2 = a.alloc(2)
+    assert a.register("k", b1) is True
+    assert a.register("k", b2) is False        # duplicate key
+    assert a.lookup_run(["k"], 8) == [b1]
+
+
+def test_blocks_for_request_math():
+    # covers the padded prompt AND every decode-fed position
+    assert blocks_for_request(3, 1, block_len=4) == 1
+    assert blocks_for_request(4, 1, block_len=4) == 1
+    assert blocks_for_request(4, 2, block_len=4) == 2   # feed at pos 4
+    assert blocks_for_request(3, 6, block_len=4) == 2   # top pos 7
+    assert blocks_for_request(3, 7, block_len=4) == 3   # top pos 8
+    # speculative headroom extends the top position
+    assert blocks_for_request(3, 6, block_len=4, headroom=4) == 3
+
+
+# --------------------------------------------------------------------- #
+# Engine: paged exactness                                               #
+# --------------------------------------------------------------------- #
+def test_paged_token_identical_across_join_retire_growth():
+    """The tentpole acceptance loop: staggered arrivals over a paged
+    pool, budgets long enough that every row's position crosses >= 1
+    block boundary mid-decode (block growth) -> every response
+    token-identical to standalone generate(), with real batching."""
+    model, params = _model()
+    rng = np.random.default_rng(7)
+    reqs = []
+    for _ in range(8):
+        s0 = int(rng.integers(3, 11))
+        reqs.append((rng.integers(0, 97, size=(s0,)).astype(np.int32),
+                     int(rng.integers(6, 14))))   # crosses 4-token blocks
+    refs = _refs(model, params, reqs)
+    with ServeEngine(model, params, max_slots=4, queue_depth=32,
+                     block_len=4) as eng:
+        resps = []
+        for i, (p, n) in enumerate(reqs):
+            resps.append(eng.submit(p, n))
+            if i % 3 == 2:
+                time.sleep(0.02)
+        outs = [r.result(timeout=300) for r in resps]
+    for out, ref in zip(outs, refs):
+        np.testing.assert_array_equal(out, ref)
+    snap = eng.stats()
+    assert snap["completed"] == 8
+    assert snap["steps_batch_gt1"] >= 1
+    assert snap["max_batch"] >= 2
+    # pool gauges present and sane
+    assert snap["block_pool_total"] > 0
+    assert snap["peak_used_blocks"] > 0
+    assert snap["peak_concurrent"] >= 2
+    assert snap["hbm_cache_bytes"] > 0
+
+
+def test_paged_prefix_reuse_exact_and_shared():
+    """Two waves sharing a long system prompt: the second wave maps the
+    cached prefix blocks copy-on-write (same PHYSICAL blocks, refcounted)
+    instead of re-prefilling, and stays token-identical to generate()."""
+    model, params = _model()
+    rng = np.random.default_rng(3)
+    sysp = rng.integers(0, 97, size=(18,)).astype(np.int32)  # 4 full blocks of 4
+    reqs = []
+    for _ in range(4):
+        sfx = rng.integers(0, 97, size=(int(rng.integers(2, 6)),)
+                           ).astype(np.int32)
+        reqs.append((np.concatenate([sysp, sfx]),
+                     int(rng.integers(4, 9))))
+    refs = _refs(model, params, reqs)
+    with ServeEngine(model, params, max_slots=2, queue_depth=16,
+                     block_len=4) as eng:
+        # wave 1 seeds the prefix index
+        out0 = eng.submit(*reqs[0]).result(timeout=300)
+        np.testing.assert_array_equal(out0, refs[0])
+        snap0 = eng.stats()
+        # wave 2: every request hits the shared prefix
+        resps = [eng.submit(p, n) for p, n in reqs[1:]]
+        outs = [r.result(timeout=300) for r in resps]
+    for out, ref in zip(outs, refs[1:]):
+        np.testing.assert_array_equal(out, ref)
+    snap = eng.stats()
+    assert snap0["prefix_hit_blocks"] == 0      # nothing cached yet
+    assert snap["prefix_hits"] >= 3
+    # each of the 3 sharers reused all 4 full system-prompt blocks
+    assert snap["prefix_hit_blocks"] >= 9
+    assert snap["prefix_lookups"] == 4
+
+
+def test_paged_pool_backpressure_and_flow_control():
+    """PoolExhausted fires typed at submit when the admitted set's
+    worst-case demand overcommits the pool; an engine whose pool is
+    momentarily full keeps the head request WAITING (flow control, not
+    failure) and serves it once retires free blocks."""
+    model, params = _model()
+    # pool: 1 slot's worth of blocks (max_total_len 32 / block_len 8 ->
+    # 4 blocks + garbage)
+    eng = ServeEngine(model, params, max_slots=2, queue_depth=8,
+                      max_total_len=32, block_len=8, n_blocks=5)
+    try:
+        r1 = eng.submit(np.asarray([1, 2, 3], np.int32), 10)  # 2 blocks
+        r2 = eng.submit(np.asarray([4, 5], np.int32), 12)     # 2 blocks
+        with pytest.raises(PoolExhausted) as ei:
+            eng.submit(np.asarray([6], np.int32), 10)         # +2 > 4
+        assert ei.value.needed == 2
+        assert ei.value.total == 4
+        assert isinstance(ei.value, QueueFull)  # retryable backpressure
+        assert eng.stats()["pool_exhausted"] == 1
+        eng.start()
+        # both requests complete: the pool serves them (possibly
+        # sequentially via head-of-line flow control)
+        assert r1.result(timeout=300).shape[0] == 13
+        assert r2.result(timeout=300).shape[0] == 14
+        # demand released: the pool admits again
+        assert eng.submit(np.asarray([7], np.int32), 4
+                          ).result(timeout=300).shape[0] == 5
+    finally:
+        eng.stop(cancel_active=True, timeout=10)
+
+
+def test_paged_zero_recompiles_after_warmup():
+    """The no-recompile invariant through the indirection, pinned: after
+    one bucket's warmup, joins, retires and block-boundary growth all
+    reuse the two compiled programs (chunk prefill + paged step)."""
+    from ray_lightning_accelerators_tpu.analysis.compile_guard import (
+        compile_guard, install)
+    install()
+    model, params = _model()
+    rng = np.random.default_rng(11)
+    # one suffix bucket: lengths 3..8 pad to 8 (block_len=8); budgets
+    # cross into later blocks mid-decode (growth)
+    reqs = [(rng.integers(0, 97, size=(int(rng.integers(3, 9)),))
+             .astype(np.int32), int(rng.integers(8, 15)))
+            for _ in range(6)]
+    refs = _refs(model, params, reqs)
+    eng = ServeEngine(model, params, max_slots=3, queue_depth=32,
+                      block_len=8)
+    eng.start()
+    try:
+        with compile_guard(max_new_compiles=2, label="paged-2prog") as g:
+            outs = [eng.submit(p, n) for p, n in reqs[:2]]
+            for r in outs:
+                r.result(timeout=300)
+        assert g.new_compiles == 2, (
+            "expected exactly 2 compiled programs (chunk prefill + "
+            f"paged step), got {g.new_compiles}")
+        # steady state: staggered joins/retires/growth add ZERO compiles
+        with compile_guard(max_new_compiles=0, label="paged-steady"):
+            resps = []
+            for i, (p, n) in enumerate(reqs):
+                resps.append(eng.submit(p, n))
+                if i % 2 == 1:
+                    time.sleep(0.02)
+            outs2 = [r.result(timeout=300) for r in resps]
+    finally:
+        eng.stop()
+    for out, ref in zip(outs2, refs):
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_paged_metrics_reset_audit():
+    """The reset-audit discipline extended to the pool fields: every new
+    counter and watermark clears; bound gauges stay wired (they read
+    live allocator state, not history)."""
+    from ray_lightning_accelerators_tpu.serve.metrics import ServeMetrics
+    m = ServeMetrics()
+    m.bind_pool(lambda: {"block_pool_total": 4, "block_pool_used": 2,
+                         "cache_waste_ratio": 0.5})
+    for c in ("prefix_lookups", "prefix_hits", "prefix_hit_blocks",
+              "speculative_requests", "speculative_tokens_accepted",
+              "pool_exhausted"):
+        m.inc(c)
+    m.observe_pool(used_blocks=7, concurrent=3)
+    m.observe_spec_round(0.01, tokens=4)
+    before = m.snapshot()
+    assert before["peak_used_blocks"] == 7
+    assert before["peak_concurrent"] == 3
+    assert before["speculative_rounds"] == 1
+    assert before["tokens_generated"] == 4
+    assert before["block_pool_used"] == 2      # gauge rides the binding
+    m.reset()
+    snap = m.snapshot()
+    for k in ServeMetrics._COUNTERS:
+        assert snap[k] == 0, f"reset missed counter {k!r}"
+    assert snap["peak_used_blocks"] == 0
+    assert snap["peak_concurrent"] == 0
+    assert snap["busy_s"] == 0.0
+    assert snap["block_pool_used"] == 2        # live gauge, still bound
+
+
+def test_paged_pool_gauges_export_to_prometheus_as_gauges():
+    from ray_lightning_accelerators_tpu.serve.metrics import ServeMetrics
+    from ray_lightning_accelerators_tpu.telemetry import MetricsRegistry
+    m = ServeMetrics()
+    m.bind_pool(lambda: {"block_pool_used": 3, "cache_waste_ratio": 0.75,
+                         "hbm_cache_bytes": 4096})
+    m.inc("completed")
+    reg = MetricsRegistry()
+    reg.add_serve(m, rank="driver")
+    text = reg.prometheus_text()
+    assert "# TYPE rla_tpu_serve_block_pool_used gauge" in text
+    assert "# TYPE rla_tpu_serve_cache_waste_ratio gauge" in text
+    assert "# TYPE rla_tpu_serve_completed_total counter" in text
+    assert 'rla_tpu_serve_cache_waste_ratio{rank="driver"} 0.75' in text
+    js = reg.to_json()
+    assert js["serve"]["driver"]["hbm_cache_bytes"] == 4096
+
+
+# --------------------------------------------------------------------- #
+# Speculative lane                                                      #
+# --------------------------------------------------------------------- #
+def _draft(vocab=97, seed=5):
+    cfg = TransformerConfig(vocab_size=vocab, d_model=32, n_heads=2,
+                            d_ff=64, n_layers=1, max_seq_len=128)
+    m = GPT(cfg)
+    return m, m.init_params(jax.random.PRNGKey(seed))
+
+
+def test_speculative_lane_exact_through_engine():
+    """Single-stream requests routed through the engine's speculative
+    lane (idle engine + draft model): token-identical to target-only
+    greedy generate(), with round/acceptance evidence and prefix reuse
+    engaged on the second request."""
+    from ray_lightning_accelerators_tpu.models.speculative import (
+        serve_speculative)
+    model, params = _model()
+    draft, dparams = _draft()
+    rng = np.random.default_rng(9)
+    sysp = rng.integers(0, 97, size=(9,)).astype(np.int32)
+    p1 = np.concatenate([sysp, rng.integers(0, 97, size=(3,)
+                                            ).astype(np.int32)])
+    p2 = np.concatenate([sysp, rng.integers(0, 97, size=(4,)
+                                            ).astype(np.int32)])
+    refs = _refs(model, params, [(p1, 9), (p2, 7)])
+    with ServeEngine(model, params, max_slots=2, queue_depth=8,
+                     block_len=4, draft_model=draft,
+                     draft_params=dparams, spec_k=4) as eng:
+        out1 = serve_speculative(eng, p1, 9, timeout=300)
+        out2 = serve_speculative(eng, p2, 7, timeout=300)
+    np.testing.assert_array_equal(out1, refs[0])
+    np.testing.assert_array_equal(out2, refs[1])
+    snap = eng.stats()
+    assert snap["speculative_requests"] == 2
+    assert snap["speculative_rounds"] >= 2
+    assert snap["completed"] == 2
+    assert snap["prefix_hits"] >= 1           # p2 reused p1's sys blocks
+    assert snap["tokens_generated"] == 9 + 7
+
+
+def test_speculative_hint_needs_draft_and_falls_back_when_busy():
+    """speculative=True without a draft model rejects typed; with a
+    draft but a BUSY engine the request serves through a normal slot —
+    same tokens either way (the routing is invisible to clients)."""
+    model, params = _model()
+    with ServeEngine(model, params, max_slots=2, block_len=4) as eng:
+        with pytest.raises(RequestRejected, match="draft model"):
+            eng.submit(np.asarray([1, 2, 3], np.int32), 4,
+                       speculative=True)
+    draft, dparams = _draft()
+    rng = np.random.default_rng(2)
+    p_bg = rng.integers(0, 97, size=(5,)).astype(np.int32)
+    p_sp = rng.integers(0, 97, size=(6,)).astype(np.int32)
+    refs = _refs(model, params, [(p_bg, 24), (p_sp, 6)])
+    with ServeEngine(model, params, max_slots=2, queue_depth=8,
+                     block_len=4, draft_model=draft,
+                     draft_params=dparams) as eng:
+        r_bg = eng.submit(p_bg, 24)          # long-running occupant
+        deadline = time.monotonic() + 30
+        while eng.stats()["prefills"] < 1:   # occupant actually placed
+            if time.monotonic() > deadline:
+                raise AssertionError("occupant never admitted")
+            time.sleep(0.005)
+        r_sp = eng.submit(p_sp, 6, speculative=True)
+        out_sp = r_sp.result(timeout=300)
+        out_bg = r_bg.result(timeout=300)
+    np.testing.assert_array_equal(out_bg, refs[0])
+    np.testing.assert_array_equal(out_sp, refs[1])
+    snap = eng.stats()
+    # the busy engine routed the hinted request through a normal slot
+    assert snap["completed"] == 2
+
+
+def test_stop_cancel_active_interrupts_speculative_lane():
+    """stop(cancel_active=True) must interrupt an in-flight speculative
+    request at its next round boundary — fast teardown cannot wait out
+    a large budget."""
+    from ray_lightning_accelerators_tpu.serve import ServeCancelled
+    model, params = _model()
+    draft, dparams = _draft()
+    eng = ServeEngine(model, params, max_slots=2, block_len=4,
+                      draft_model=draft, draft_params=dparams, spec_k=4)
+    orig = eng._d_propose
+
+    def slow_propose(*a):
+        time.sleep(0.05)   # stretch each round: a wide cancel window
+        return orig(*a)
+
+    eng._d_propose = slow_propose
+    eng.start()
+    try:
+        p = np.asarray([1, 2, 3, 4], np.int32)
+        r = eng.submit(p, 40, speculative=True)   # >= 8 rounds of work
+        deadline = time.monotonic() + 30
+        while eng.stats()["speculative_rounds"] < 1:
+            if time.monotonic() > deadline:
+                raise AssertionError("speculative lane never started")
+            time.sleep(0.005)
+        eng.stop(cancel_active=True, timeout=30)
+        with pytest.raises(ServeCancelled, match="speculative"):
+            r.result(timeout=5)
+        assert eng.stats()["cancelled"] >= 1
+        assert eng.allocator.stats()["used"] == 0   # blocks released
+    finally:
+        eng.stop(cancel_active=True, timeout=5)
+
+
+def test_admission_failure_fails_the_popped_request_typed():
+    """A prefill that dies mid-admission must fail THAT request's future
+    (it is in neither the queue nor a slot) and release its blocks —
+    not leave the client hanging until timeout."""
+    model, params = _model()
+    eng = ServeEngine(model, params, max_slots=2, block_len=4)
+
+    def boom(_padded_len):
+        raise RuntimeError("prefill exploded")
+
+    eng._chunk_prefill_fn = boom
+    eng.start()
+    try:
+        r = eng.submit(np.asarray([1, 2, 3], np.int32), 4)
+        with pytest.raises(RuntimeError, match="prefill exploded"):
+            r.result(timeout=30)
+        assert eng.stats()["failed"] == 1
+        # the failed request's blocks went back to the pool
+        assert eng.allocator.stats()["used"] == 0
+    finally:
+        eng._thread = None  # loop already died; stop() must not join it
+        eng.stop(cancel_active=True, timeout=5)
+
+
+def test_dense_mode_still_exact_and_program_counted():
+    """paged=False keeps the PR 2 dense engine intact (the probe's
+    placed-bytes baseline): exactness + no pool fields in the snapshot."""
+    model, params = _model()
+    rng = np.random.default_rng(4)
+    reqs = [(rng.integers(0, 97, size=(int(rng.integers(3, 9)),))
+             .astype(np.int32), int(rng.integers(4, 9)))
+            for _ in range(4)]
+    refs = _refs(model, params, reqs)
+    with ServeEngine(model, params, max_slots=2, paged=False) as eng:
+        outs = [eng.submit(p, n).result(timeout=300) for p, n in reqs]
+    for out, ref in zip(outs, refs):
+        np.testing.assert_array_equal(out, ref)
+    snap = eng.stats()
+    assert snap["completed"] == 4
+    assert "block_pool_total" not in snap

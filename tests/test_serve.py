@@ -82,23 +82,40 @@ def test_single_token_budget_completes_at_prefill():
 
 def test_admission_typed_backpressure():
     """QueueFull / RequestRejected are typed and counted; an unstarted
-    engine never dequeues, so the bound is deterministic."""
-    model, params = _model(max_seq_len=32)
-    eng = ServeEngine(model, params, max_slots=1, queue_depth=2,
-                      max_total_len=24)
+    engine never dequeues, so the bound is deterministic.  Paged
+    admission judges against the BLOCK budgets: a request the old dense
+    check would have refused against max_total_len is admitted when its
+    blocks fit, and the typed rejection names both pool budgets."""
+    model, params = _model(max_seq_len=48)
+    eng = ServeEngine(model, params, max_slots=1, queue_depth=3,
+                      max_total_len=24, block_len=16, n_blocks=9)
     try:
         eng.submit(np.asarray([1, 2], np.int32), 4)
         eng.submit(np.asarray([3], np.int32), 4)
+        # 20 + 10 = 30 tokens: the DENSE check (max_total_len=24) would
+        # refuse this, but it needs only 2 blocks of 16 — admitted
+        eng.submit(np.asarray([1] * 20, np.int32), 10)
         with pytest.raises(QueueFull, match="depth cap"):
             eng.submit(np.asarray([4], np.int32), 4)
-        with pytest.raises(RequestRejected, match="budget"):
-            eng.submit(np.asarray([1] * 20, np.int32), 10)
+        # genuinely infeasible: 3 blocks > the 2-block per-slot table
+        # (total 40 <= the model's 48, so the BLOCK budgets reject);
+        # the typed error names both budgets
+        with pytest.raises(RequestRejected,
+                           match="block-table budget"):
+            eng.submit(np.asarray([1] * 20, np.int32), 20)
+        with pytest.raises(RequestRejected, match="pool"):
+            eng.submit(np.asarray([1] * 20, np.int32), 20)
+        # block rounding grants the table 32 positions, but the MODEL
+        # is shaped for 48 total — 20 + 30 = 50 must reject exactly
+        # like generate() would, whatever the table could hold
+        with pytest.raises(RequestRejected, match="max_seq_len"):
+            eng.submit(np.asarray([1] * 20, np.int32), 30)
         with pytest.raises(RequestRejected, match="empty"):
             eng.submit(np.asarray([], np.int32), 4)
         with pytest.raises(RequestRejected, match="max_new_tokens"):
             eng.submit(np.asarray([1, 2], np.int32), 0)
-        # QueueFull + three RequestRejected = 4 typed rejections counted
-        assert eng.stats()["rejected"] == 4
+        # QueueFull + five RequestRejected = 6 typed rejections counted
+        assert eng.stats()["rejected"] == 6
     finally:
         eng.stop(cancel_active=True, timeout=5)
 

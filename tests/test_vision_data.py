@@ -155,7 +155,11 @@ def test_predict_gate_on_real_mnist(tmp_path):
     dm.setup("fit")
     assert dm.source == "real"
     model = MNISTClassifier({"lr": 1e-3, "batch_size": 32})
-    trainer = Trainer(max_epochs=4, precision="f32", seed=0,
+    # 8 epochs: 4 epochs (56 steps) left the gate on a knife edge --
+    # measured 0.4765 vs the 0.5 bar on this jax build, deterministic
+    # run-to-run, reproduced on clean HEAD; the smoke gate's intent is
+    # "the pipeline learns real data", not "converge in 56 steps"
+    trainer = Trainer(max_epochs=8, precision="f32", seed=0,
                       enable_checkpointing=False,
                       default_root_dir=str(tmp_path / "run"))
     predict_test(trainer, model, dm)
