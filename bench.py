@@ -20,13 +20,14 @@
   matmul-shaped read (the access pattern decode actually has).
 
 - ``gradexchange`` / ``input_pipeline`` / ``fsdp_exchange`` /
-  ``paged_serve`` (CPU-mesh subprocess benches): quantized-allreduce
-  wire-bytes reduction, async-input-pipeline prefetch speedup,
-  compressed-FSDP exchange, and paged-KV-cache concurrency-per-HBM,
-  each measured by a self-contained probe script that forces an
-  8-device host-platform CPU mesh before backend init.  They double as
-  the dead-backend fallback set: a window whose accelerator probe fails
-  still emits their real metric lines and exits 0.
+  ``paged_serve`` / ``mfu_overlap`` (CPU-mesh subprocess benches):
+  quantized-allreduce wire-bytes reduction, async-input-pipeline
+  prefetch speedup, compressed-FSDP exchange, paged-KV-cache
+  concurrency-per-HBM, and the overlap-aware scan-gather + step
+  autotune loop, each measured by a self-contained probe script that
+  forces an 8-device host-platform CPU mesh before backend init.  They
+  double as the dead-backend fallback set: a window whose accelerator
+  probe fails still emits their real metric lines and exits 0.
 
 Each timed region is the steady state of a single public-API ``fit`` --
 epoch 1 absorbs compile + the one-time device-cache shipment, later epochs
@@ -127,16 +128,26 @@ class _EpochClock:
     the device queue.  (``block_until_ready`` is NOT trusted here: through
     a tunneled PjRt client it can return before the device work ran.)
     Marks at epoch start AND end keep the timed window free of fit()'s
-    final full-parameter download."""
+    final full-parameter download.
+
+    Also snapshots the compile-guard counter at every boundary, so the
+    steady-state window carries its own bench-honesty record: a nonzero
+    ``window_compiles()`` means a retrace landed inside the timed epochs
+    and the step time is polluted."""
 
     def __init__(self, base):
         import jax
         import numpy as np
 
+        from ray_lightning_accelerators_tpu.analysis import (
+            compile_guard as cg)
+
         class _CB(base):
             def __init__(cb_self):
                 cb_self.starts = []
                 cb_self.ends = []
+                cb_self.compiles_at_start = []
+                cb_self.compiles_at_end = []
 
             def _sync(cb_self, trainer):
                 if trainer._state is not None:
@@ -145,15 +156,21 @@ class _EpochClock:
 
             def on_train_epoch_start(cb_self, trainer, module):
                 cb_self.starts.append(cb_self._sync(trainer))
+                cb_self.compiles_at_start.append(cg.compile_count())
 
             def on_train_epoch_end(cb_self, trainer, module):
                 cb_self.ends.append(cb_self._sync(trainer))
+                cb_self.compiles_at_end.append(cg.compile_count())
 
         self.cb = _CB()
 
     def steady_state_seconds(self) -> float:
         """Epoch-2-start .. last-epoch-end (epoch 1 absorbs compile)."""
         return self.cb.ends[-1] - self.cb.starts[1]
+
+    def window_compiles(self) -> int:
+        """Backend compiles landing inside the timed window (0 = clean)."""
+        return self.cb.compiles_at_end[-1] - self.cb.compiles_at_start[1]
 
 
 def bench_mnist() -> dict:
@@ -251,12 +268,26 @@ def bench_gpt() -> dict:
 def _bench_gpt(loss_chunk: int, flash_block: int,
                steps_per_epoch: int, per_chip_batch: int = 16,
                remat: bool = False, remat_policy: str = "nothing",
-               tiny: bool = False) -> dict:
+               tiny: bool = False, small: bool = False, epochs: int = 3,
+               use_fsdp: bool = False, gather_mode: str = "tree",
+               grad_compression: str | None = None,
+               int8_matmul: bool = False,
+               precision: str = "bf16") -> dict:
     """One bench-shaped GPT training measurement.  The extra knobs serve
     scripts/mfu_sweep.py's variant ladder; keeping them HERE means every
     sweep number is produced under exactly the timed-window/sync
     discipline the driver's bench uses (``tiny`` shrinks the model for
-    CPU plumbing smokes -- its MFU is meaningless)."""
+    CPU plumbing smokes; ``small`` is the CPU-mesh-measurable middle
+    size the overlap probe uses — enough layers/params for the gather
+    schedule to matter, small enough for an 8-device host CPU mesh;
+    MFU is meaningless for both).
+
+    ``use_fsdp``/``gather_mode``/``grad_compression`` engage the
+    compressed-FSDP step (parallel/collectives.py): "tree" all-gathers
+    the whole bf16 param tree before the forward, "scan" overlaps a
+    layer-wise gather inside the transformer scan.  ``int8_matmul``
+    routes the MLP projections through int8 forward matmuls with
+    straight-through gradients (ops/quant.py)."""
     import jax
     import numpy as np
 
@@ -268,15 +299,22 @@ def _bench_gpt(loss_chunk: int, flash_block: int,
     from ray_lightning_accelerators_tpu.utils import profiler as prof
 
     n_devices = jax.device_count()
-    seq = 256 if tiny else 1024
+    seq = 256 if tiny else (128 if small else 1024)
     if tiny:
         per_chip_batch = min(per_chip_batch, 2)
+    if small:
+        per_chip_batch = min(per_chip_batch, 4)
     batch = per_chip_batch * n_devices
-    cfg = TransformerConfig(vocab_size=512 if tiny else 50304,
-                            d_model=128 if tiny else 768,
-                            n_heads=4 if tiny else 12,
-                            d_ff=512 if tiny else 3072,
-                            n_layers=2 if tiny else 12, max_seq_len=seq,
+    if tiny:
+        dims = dict(vocab_size=512, d_model=128, n_heads=4, d_ff=512,
+                    n_layers=2)
+    elif small:
+        dims = dict(vocab_size=2048, d_model=192, n_heads=6, d_ff=768,
+                    n_layers=6)
+    else:
+        dims = dict(vocab_size=50304, d_model=768, n_heads=12, d_ff=3072,
+                    n_layers=12)
+    cfg = TransformerConfig(**dims, max_seq_len=seq,
                             fused_loss=True, loss_chunk_rows=loss_chunk,
                             flash_block_q=flash_block,
                             flash_block_k=flash_block,
@@ -291,11 +329,13 @@ def _bench_gpt(loss_chunk: int, flash_block: int,
                         shuffle=False)
 
     clock = _EpochClock(Callback)
-    epochs = 3
-    trainer = Trainer(max_epochs=epochs, accelerator=RayTPUAccelerator(),
-                      precision="bf16", enable_checkpointing=False,
+    trainer = Trainer(max_epochs=epochs,
+                      accelerator=RayTPUAccelerator(use_fsdp=use_fsdp),
+                      precision=precision, enable_checkpointing=False,
                       log_every_n_steps=10 ** 9, seed=0,
                       callbacks=[clock.cb],
+                      grad_compression=grad_compression,
+                      gather_mode=gather_mode, int8_matmul=int8_matmul,
                       default_root_dir="/tmp/rla_tpu_bench_gpt")
     trainer.fit(model, loader)
 
@@ -314,7 +354,7 @@ def _bench_gpt(loss_chunk: int, flash_block: int,
     flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
     flops_per_step = flops_per_token * batch * seq
     mfu = prof.mfu(flops_per_step / n_devices, step_time)
-    return {
+    rec = {
         "metric": "gpt2s_124m_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
@@ -322,10 +362,20 @@ def _bench_gpt(loss_chunk: int, flash_block: int,
         "step_ms": round(step_time * 1e3, 1),
         "params": n_params,
         "seq_len": seq,
+        "measured_window_compiles": clock.window_compiles(),
         "peak_flops_note": "per-chip bf16 peak from device_kind "
                            "(v5e-class 197e12)",
         "vs_baseline": round(mfu / GPT_MFU_TARGET, 3),
     }
+    if use_fsdp and grad_compression is not None:
+        # the exposed-vs-hidden wire split for THIS step's gather mode
+        # (collectives.wire_bytes_per_step via the trainer's record)
+        comms = trainer.comms_per_step or {}
+        for k in ("gather_mode", "exposed_bytes_per_step",
+                  "hidden_bytes_per_step"):
+            if k in comms:
+                rec[k] = comms[k]
+    return rec
 
 
 def bench_cifar() -> dict:
@@ -594,11 +644,22 @@ def bench_paged_serve() -> dict:
     return _run_cpu_probe("paged_serve_probe.py", "paged_serve")
 
 
+def bench_mfu_overlap() -> dict:
+    """Overlap-aware FSDP gather bench (layer-wise param all-gather
+    inside the transformer scan vs whole-tree up-front,
+    parallel/collectives.py + the tune.autotune_step closed loop):
+    scan/tree step-time ratio under remat + the analytic exposed-comm
+    reduction, on a forced-host-platform 8-device CPU mesh (see
+    ``_run_cpu_probe``)."""
+    return _run_cpu_probe("mfu_overlap_probe.py", "mfu_overlap")
+
+
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
            "input_pipeline": bench_input_pipeline,
            "fsdp_exchange": bench_fsdp_exchange,
-           "paged_serve": bench_paged_serve}
+           "paged_serve": bench_paged_serve,
+           "mfu_overlap": bench_mfu_overlap}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -622,7 +683,7 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 # subprocess: they cannot be taken down by a dead accelerator backend,
 # so they double as the probe-failure fallback set
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
-                         "fsdp_exchange", "paged_serve")
+                         "fsdp_exchange", "paged_serve", "mfu_overlap")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -725,7 +786,7 @@ def main() -> None:
     parser.add_argument(
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
-                "fsdp_exchange,paged_serve",
+                "fsdp_exchange,paged_serve,mfu_overlap",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--probe-timeout", type=float,
                         default=float(os.environ.get(

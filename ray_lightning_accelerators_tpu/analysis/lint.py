@@ -355,11 +355,19 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     # the flight recorder's emit runs inside every other hot root: it
     # must never host-sync or allocate unboundedly (telemetry/)
     "telemetry/recorder.py": ("FlightRecorder.emit",),
-    # the compressed-FSDP exchange + param gather are compiled INTO the
+    # the compressed-FSDP exchange + param gathers are compiled INTO the
     # train step: their builders (and shard_map bodies) must stay
-    # host-sync-free and build no jits in loops
+    # host-sync-free and build no jits in loops.  The scan-gather pair
+    # additionally owns the in-scan layer hook the model body runs every
+    # layer — a sync there would stall the whole overlapped schedule.
     "parallel/collectives.py": ("build_fsdp_exchange",
-                                "build_param_gather"),
+                                "build_param_gather",
+                                "build_scan_param_gather",
+                                "build_scan_local_grads"),
+    # the autotune closed loop re-measures the train step in a tight
+    # trial loop: its driver must not leak jit builds or stray host
+    # syncs beyond the deliberate timing measurement it exists for
+    "tune/run.py": ("autotune_step",),
 }
 
 # modules whose code runs inside dispatched workers: typed exceptions

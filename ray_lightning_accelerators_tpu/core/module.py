@@ -32,6 +32,12 @@ StepOutput = Union[jax.Array, Tuple[jax.Array, Dict[str, jax.Array]]]
 class TpuModule:
     """Base class for user models."""
 
+    # int8 forward matmuls inside the TRAIN step (Trainer(int8_matmul=
+    # True) sets it): modules that support it (GPT routes its MLP
+    # projections through per-out-channel int8 with straight-through
+    # gradients) read this flag; others ignore it
+    int8_matmul: bool = False
+
     def __init__(self):
         self.hparams: Dict[str, Any] = {}
         self.params: Any = None          # populated by Trainer after fit()
@@ -70,6 +76,15 @@ class TpuModule:
 
     def forward(self, params: Any, batch: Any) -> Any:
         raise NotImplementedError
+
+    def scanned_param_subtrees(self) -> Tuple[str, ...]:
+        """Top-level param-tree keys holding layer-STACKED leaves that a
+        ``lax.scan`` iterates (GPT: ``("layers",)``).  The overlap-aware
+        FSDP gather (``Trainer(gather_mode="scan")``) keeps these
+        fsdp-sharded as scan operands and all-gathers each layer inside
+        the scan body; modules without a layer scan return ``()`` and
+        fall back to the whole-tree gather."""
+        return ()
 
     def on_validation_epoch_end(self) -> None:
         """Host-side hook after each validation pass (not traced)."""

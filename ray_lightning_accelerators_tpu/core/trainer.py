@@ -87,6 +87,8 @@ class Trainer:
                  worker_deadline_s: Optional[float] = None,
                  grad_compression: Optional[str] = None,
                  shard_optimizer_state: bool = False,
+                 gather_mode: str = "tree",
+                 int8_matmul: bool = False,
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -188,6 +190,26 @@ class Trainer:
         # unchanged; the update is elementwise), ~3x less optimizer
         # memory per device for Adam-family optimizers
         self.shard_optimizer_state = shard_optimizer_state
+        # how the compressed-FSDP step assembles its bf16 compute view
+        # (parallel/collectives.py GATHER_MODES): "tree" all-gathers the
+        # whole param tree before the forward (PR 8); "scan" keeps the
+        # module's declared layer stacks fsdp-sharded as scan operands
+        # and all-gathers each layer INSIDE the layer scan — XLA
+        # overlaps layer k+1's gather with layer k's matmuls, the
+        # backward re-gathers per layer under the remat policy, and the
+        # per-layer gradient reduce-scatter rides the gather's autodiff
+        # transpose (exact bf16, overlapped).  Falls back to "tree"
+        # (with a warning) for modules without a scanned layer stack.
+        if gather_mode not in collectives_lib.GATHER_MODES:
+            raise ValueError(
+                f"gather_mode must be one of "
+                f"{collectives_lib.GATHER_MODES}, got {gather_mode!r}")
+        self.gather_mode = gather_mode
+        # int8 forward matmuls inside the train step (models that
+        # support it — GPT's MLP projections — read the module flag;
+        # ops/quant.py kernels where shapes allow, int8-rounded XLA dots
+        # otherwise, straight-through gradients either way)
+        self.int8_matmul = int8_matmul
         # analytic bytes-on-wire record for the compiled gradient
         # exchange (collectives.wire_bytes_per_step); also mirrored onto
         # the profiler when one is attached
@@ -240,6 +262,11 @@ class Trainer:
         # param shardings when the compressed exchange runs in the FSDP
         # (reduce-scatter/all-gather) regime; None = replicated-DP regime
         self._fsdp_param_sh = None
+        # (effective gather mode, scanned top-level keys) resolved per
+        # compile — "scan" only when the FSDP regime is live AND the
+        # module declares a compatible layer stack
+        self._gather_mode_eff = "tree"
+        self._scanned_keys: tuple = ()
         # persistent fan-out world (spawned agent workers + formed
         # jax.distributed world), reused across entry points; see
         # _acquire_world / shutdown_workers
@@ -536,7 +563,14 @@ class Trainer:
                     if template is not candidates[-1][1] else "")
                 continue
             if name == "full":
-                return restored
+                # orbax happily restores SAVED-shaped buffers over a
+                # differently-shaped template; per-replica exchange
+                # buffers whose layout changed between runs (a
+                # gather_mode flip swaps real residuals for
+                # placeholders and back) must reset to this run's fresh
+                # zeros instead of silently adopting the saved layout
+                return self._reset_mismatched_exchange_buffers(
+                    restored, state)
             # non-full template: this run keeps its own fresh (zero)
             # residual/accumulator buffers -- error feedback loses at
             # most one step of history
@@ -546,6 +580,39 @@ class Trainer:
             return restored.replace(residual=state.residual,
                                     grad_accum=state.grad_accum)
         raise last_exc
+
+    @staticmethod
+    def _reset_mismatched_exchange_buffers(restored: TrainState,
+                                           template: TrainState
+                                           ) -> TrainState:
+        """Per-replica exchange buffers (error-feedback residuals,
+        gradient accumulators) restored with shapes this run's layout
+        does not expect — a gather_mode flip swaps real residuals for
+        placeholders and back, and neither orbax nor flax
+        ``from_state_dict`` shape-checks — reset to the template's
+        fresh zeros (error feedback loses at most one step of
+        history)."""
+
+        def mismatched(field) -> bool:
+            t = getattr(template, field)
+            r = getattr(restored, field)
+            if t is None or r is None:
+                return (t is None) != (r is None)
+            tl, rl = jax.tree.leaves(t), jax.tree.leaves(r)
+            return (len(tl) != len(rl) or any(
+                tuple(np.shape(a)) != tuple(np.shape(b))
+                for a, b in zip(tl, rl)))
+
+        bad = [f for f in ("residual", "grad_accum") if mismatched(f)]
+        if bad:
+            log.warning(
+                "restored %s buffers do not match this run's exchange "
+                "layout (gather_mode or compression change); resetting "
+                "them to zero — error feedback loses at most one step "
+                "of history", "/".join(bad))
+            restored = restored.replace(
+                **{f: getattr(template, f) for f in bad})
+        return restored
 
     def _restore(self, ckpt_path: str, state: TrainState) -> TrainState:
         from ..utils import sharded_checkpoint as sharded_lib
@@ -568,7 +635,8 @@ class Trainer:
                 for k in ("residual", "grad_accum"):
                     if payload["state"].get(k) is not None:
                         payload["state"][k] = None
-            state = ckpt_lib.restore_state(payload, state)
+            state = self._reset_mismatched_exchange_buffers(
+                ckpt_lib.restore_state(payload, state), state)
         self.current_epoch = payload["epoch"]
         self.epochs_completed = payload["epoch"]
         self.global_step = payload["global_step"]
@@ -651,6 +719,46 @@ class Trainer:
                             mesh, state.params)
         return state_sh
 
+    def _resolve_gather_mode(self, module, params, param_sh,
+                             quiet: bool = False):
+        """(effective gather mode, scanned top-level keys) for this
+        run.  "scan" engages only when the user asked for it AND the
+        module declares scanned param subtrees whose layout the in-scan
+        gather can handle; anything else warns (once, from the
+        authoritative _compile resolution) and falls back to the
+        whole-tree gather — correct, just not overlapped."""
+        from ..parallel import collectives as collectives_lib
+
+        if self.gather_mode != "scan":
+            return "tree", ()
+        scanned = tuple(getattr(module, "scanned_param_subtrees",
+                                lambda: ())())
+        reason = None
+        if not scanned:
+            reason = ("module declares no scanned param subtrees "
+                      "(scanned_param_subtrees)")
+        elif not isinstance(params, dict) \
+                or any(k not in params for k in scanned):
+            reason = (f"scanned keys {scanned} are not top-level keys "
+                      f"of the param tree")
+        else:
+            try:
+                collectives_lib.validate_scan_gather(param_sh, scanned)
+            except collectives_lib.TensorShardedParamsError as e:
+                reason = str(e)
+        if reason is None and not any(
+                collectives_lib.fsdp_shard_dim(s) is not None
+                for k in scanned
+                for s in jax.tree.leaves(param_sh[k])):
+            reason = ("no scanned leaf is fsdp-sharded — nothing to "
+                      "gather inside the scan")
+        if reason is not None:
+            if not quiet:
+                log.warning("gather_mode='scan' falls back to 'tree': %s",
+                            reason)
+            return "tree", ()
+        return "scan", scanned
+
     def _compile(self, module: TpuModule, state: TrainState, example_batch):
         from ..parallel import collectives as collectives_lib
 
@@ -658,6 +766,11 @@ class Trainer:
         module.mesh = mesh  # models use this for sharding constraints
         batch_sh = self.accelerator.batch_sharding(mesh)
         state_sh = self._resolve_state_shardings(module, state)
+        self._gather_mode_eff, self._scanned_keys = ("tree", ())
+        if self._fsdp_param_sh is not None:
+            self._gather_mode_eff, self._scanned_keys = \
+                self._resolve_gather_mode(module, state.params,
+                                          self._fsdp_param_sh)
         from ..parallel.sharding import validate_shardings
         validate_shardings(state.params, state_sh.params, mesh)
         if self.profiler is not None:
@@ -761,7 +874,9 @@ class Trainer:
             # regime: reduce-scatter + bf16 param all-gather accounting)
             report = collectives_lib.wire_bytes_per_step(
                 state.params, collectives_lib.dp_size(mesh),
-                self._exchange_cfg, param_shardings=self._fsdp_param_sh)
+                self._exchange_cfg, param_shardings=self._fsdp_param_sh,
+                gather_mode=self._gather_mode_eff,
+                scanned=self._scanned_keys)
             self.comms_per_step = report
             if self.profiler is not None:
                 self.profiler.record_comms(report)
@@ -798,11 +913,12 @@ class Trainer:
                 sq = optax.global_norm(local_grads) ** 2
                 return {"grad_norm": jnp.sqrt(jax.lax.pmean(sq, axes))}
 
-        local_grad_fn = collectives_lib.build_local_grads(
-            mesh, vag, batch_sh.spec, extra_metrics=extra)
         if self._fsdp_param_sh is not None:
             return self._build_fsdp_train_step(
-                mesh, cfg, k, local_grad_fn, apply_grads, step_metrics_lr)
+                mesh, cfg, k, vag, extra, batch_sh, apply_grads,
+                step_metrics_lr)
+        local_grad_fn = collectives_lib.build_local_grads(
+            mesh, vag, batch_sh.spec, extra_metrics=extra)
         exchange_fn = collectives_lib.build_exchange(mesh, cfg)
 
         def train_step(st: TrainState, batch):
@@ -846,16 +962,33 @@ class Trainer:
 
         return train_step
 
-    def _build_fsdp_train_step(self, mesh, cfg, k, local_grad_fn,
+    def _build_fsdp_train_step(self, mesh, cfg, k, vag, extra, batch_sh,
                                apply_grads, step_metrics_lr):
         """The compressed-FSDP (ZeRO-2/3) train step: params live SHARDED
         over the fsdp axis (with their optimizer state — 1/N each), the
-        compute view is a bf16 all-gather
-        (``collectives.build_param_gather``), per-replica grads
-        reduce-scatter quantized INTO the shard owner
-        (``collectives.build_fsdp_exchange``, shard-local error-feedback
-        residuals), and the optimizer update runs shard-local — XLA
-        partitions the elementwise update from the matching layouts.
+        compute view is a bf16 all-gather, per-replica grads land back
+        INTO the shard owner, and the optimizer update runs shard-local —
+        XLA partitions the elementwise update from the matching layouts.
+
+        Two gather schedules (``Trainer(gather_mode=...)``):
+
+        - ``tree`` (PR 8): the whole bf16 compute tree is all-gathered
+          BEFORE the forward (``collectives.build_param_gather``) and the
+          grads reduce-scatter quantized through
+          ``collectives.build_fsdp_exchange`` afterwards — simple, but
+          the gather latency serializes with compute and the replicated
+          tree stays live through the backward.
+        - ``scan``: the module's layer stacks stay fsdp-sharded as scan
+          operands; each layer's bf16 shards are all-gathered INSIDE the
+          layer scan (``collectives.build_scan_param_gather`` hooks,
+          applied by the model's scan body), so XLA overlaps layer k+1's
+          gather with layer k's matmuls, and the gather's autodiff
+          transpose reduce-scatters each layer's gradient (exact bf16)
+          into its owner inside the equally-overlapped backward — under
+          a remat policy that drops gathered weights, the backward
+          re-gathers per layer instead of holding the replicated tree
+          live.  Non-stacked leaves (embeddings, final norm) keep the
+          up-front gather + quantized exchange.
 
         ``accumulate_grad_batches > 1`` accumulates the POST-exchange
         owned shards in ``TrainState.grad_accum`` (param-shaped, so the
@@ -865,16 +998,9 @@ class Trainer:
         optimizer update on the window boundary."""
         from ..parallel import collectives as collectives_lib
 
-        gather_fn = collectives_lib.build_param_gather(
-            mesh, self._fsdp_param_sh)
-        exchange_fn = collectives_lib.build_fsdp_exchange(
-            mesh, cfg, self._fsdp_param_sh)
-
-        def train_step(st: TrainState, batch):
-            step_rng = jax.random.fold_in(st.rng, st.step)
-            compute_params = gather_fn(st.params)
-            metrics, local = local_grad_fn(compute_params, batch, step_rng)
-            gshard, new_res = exchange_fn(local, st.residual)
+        def finish(st, metrics, gshard, new_res):
+            """Shared tail: apply now (k == 1) or accumulate the owned
+            shards and update at the window boundary."""
             if k == 1:
                 new_params, new_opt = apply_grads(gshard, st.opt_state,
                                                   st.params)
@@ -907,6 +1033,57 @@ class Trainer:
                                    opt_state=new_opt, residual=new_res,
                                    grad_accum=new_acc)
             return new_state, step_metrics_lr(st, metrics)
+
+        if self._gather_mode_eff == "scan":
+            scanned = self._scanned_keys
+            prelude, hooks = collectives_lib.build_scan_param_gather(
+                mesh, self._fsdp_param_sh, scanned)
+            local_scan_fn = collectives_lib.build_scan_local_grads(
+                mesh, vag, batch_sh.spec, self._fsdp_param_sh, scanned,
+                hooks, extra_metrics=extra)
+            rest_sh = {kk: v for kk, v in self._fsdp_param_sh.items()
+                       if kk not in scanned}
+            exchange_rest = (collectives_lib.build_fsdp_exchange(
+                mesh, cfg, rest_sh) if rest_sh else None)
+
+            def train_step(st: TrainState, batch):
+                step_rng = jax.random.fold_in(st.rng, st.step)
+                compute_params = prelude(st.params)
+                metrics, grads = local_scan_fn(compute_params, batch,
+                                               step_rng)
+                # scanned leaves came back finished (exact mean, owner
+                # layout — the in-scan gather's transpose); only the
+                # rest rides the quantized exchange
+                if exchange_rest is not None:
+                    rest_out, rest_res = exchange_rest(
+                        {kk: v for kk, v in grads.items()
+                         if kk not in scanned},
+                        {kk: v for kk, v in st.residual.items()
+                         if kk not in scanned})
+                    gshard = dict(rest_out)
+                    gshard.update({kk: grads[kk] for kk in scanned})
+                    new_res = dict(rest_res)
+                    new_res.update({kk: st.residual[kk]
+                                    for kk in scanned})
+                else:
+                    gshard, new_res = grads, st.residual
+                return finish(st, metrics, gshard, new_res)
+
+            return train_step
+
+        local_grad_fn = collectives_lib.build_local_grads(
+            mesh, vag, batch_sh.spec, extra_metrics=extra)
+        gather_fn = collectives_lib.build_param_gather(
+            mesh, self._fsdp_param_sh)
+        exchange_fn = collectives_lib.build_fsdp_exchange(
+            mesh, cfg, self._fsdp_param_sh)
+
+        def train_step(st: TrainState, batch):
+            step_rng = jax.random.fold_in(st.rng, st.step)
+            compute_params = gather_fn(st.params)
+            metrics, local = local_grad_fn(compute_params, batch, step_rng)
+            gshard, new_res = exchange_fn(local, st.residual)
+            return finish(st, metrics, gshard, new_res)
 
         return train_step
 
@@ -1529,6 +1706,8 @@ class Trainer:
         self.module = module
         module.trainer = self
         module.compute_dtype = self.compute_dtype
+        if self.int8_matmul:
+            module.int8_matmul = True
 
         if datamodule is not None:
             datamodule.setup("fit")
@@ -1581,9 +1760,15 @@ class Trainer:
                 collectives_lib.fsdp_shard_dim(s) is not None
                 for s in jax.tree.leaves(param_sh))
             if fsdp_mode:
+                # scan-gathered leaves never ride the quantized exchange
+                # (their reduce-scatter is the in-scan gather's exact
+                # transpose), so they get residual placeholders
+                _, scanned = self._resolve_gather_mode(
+                    module, init_params, param_sh, quiet=True)
                 state = state.replace(
                     residual=collectives_lib.fsdp_residual_zeros(
-                        init_params, param_sh, self._exchange_cfg),
+                        init_params, param_sh, self._exchange_cfg,
+                        scanned=scanned),
                     grad_accum=(jax.tree.map(
                         lambda p: jnp.zeros(p.shape, jnp.float32),
                         init_params)

@@ -18,6 +18,7 @@ exists to exercise and benchmark the framework's TPU path end-to-end:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -26,6 +27,7 @@ import optax
 
 from ..analysis import knobs
 from ..core.module import TpuModule
+from ..parallel import collectives as collectives_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from ..parallel.ring_attention import ring_attention_sharded
@@ -153,6 +155,56 @@ def _rope_grid(x: jax.Array, positions: jax.Array,
     return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _channel_quant(w: jax.Array):
+    """Per-out-channel symmetric int8 of a [K, N] weight: (q8 int8,
+    scale [N] f32, dq [K, N] f32).  Same scale convention as
+    ``GPT.quantize_weights`` / ``ops.quant.int8_matmul``."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -127, 127)
+    return q.astype(jnp.int8), scale, q * scale[None, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _int8_ste_matmul(mode, x2d, w):
+    """Training-forward int8 matmul with straight-through gradients:
+    ``x2d [M, K] @ int8(w [K, N])``.  The forward streams int8 through
+    the ops/quant.py Pallas kernel when ``mode`` says so ("compiled" on
+    TPU, "interpret" in CPU tests; None = XLA dequant-dot — still the
+    int8-rounded VALUES, so the loss-tolerance story is identical); the
+    backward is the standard straight-through estimator: cotangents flow
+    through the dequantized weights and straight to the f32 master (the
+    round is a zero-gradient a.e. staircase — without STE the weights
+    would never train)."""
+    out, _ = _int8_ste_fwd(mode, x2d, w)
+    return out
+
+
+def _int8_ste_fwd(mode, x2d, w):
+    q8, scale, dq = _channel_quant(w)
+    if mode in ("compiled", "interpret"):
+        from ..ops import quant
+        out = quant.int8_matmul(x2d, q8, scale,
+                                interpret=mode == "interpret")
+    else:
+        out = x2d @ dq.astype(x2d.dtype)
+    # residual dequant kept in w's dtype so both cotangents match their
+    # primal avals exactly
+    return out.astype(x2d.dtype), (x2d, dq.astype(w.dtype))
+
+
+def _int8_ste_bwd(mode, res, g):
+    x2d, dq = res
+    gx = (g.astype(jnp.float32) @ dq.T.astype(jnp.float32)
+          ).astype(x2d.dtype)
+    gw = (x2d.astype(jnp.float32).T @ g.astype(jnp.float32))
+    return gx, gw.astype(dq.dtype)
+
+
+_int8_ste_matmul.defvjp(_int8_ste_fwd, _int8_ste_bwd)
+
+
 def _remat_policy(name: str):
     """Map a config string to a jax.checkpoint policy."""
     policies = {
@@ -269,6 +321,12 @@ class GPT(TpuModule):
             axes["unembed"] = ("embed", "vocab")
         return axes
 
+    def scanned_param_subtrees(self) -> Tuple[str, ...]:
+        """The layer stack is scanned — the overlap-aware FSDP gather
+        (``Trainer(gather_mode="scan")``) keeps it fsdp-sharded as scan
+        operands and all-gathers each layer inside the scan body."""
+        return ("layers",)
+
     # ------------------------------------------------------------------ #
     # Forward                                                            #
     # ------------------------------------------------------------------ #
@@ -334,6 +392,27 @@ class GPT(TpuModule):
         keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
 
+    def _mlp_train_matmul(self, x, w, dt):
+        """Training MLP projection ``[b,s,din] @ w[din,dout]``.  With
+        ``int8_matmul`` (Trainer flag) the forward runs through
+        per-out-channel int8 (the ops/quant.py kernel where its shape
+        bounds allow — decode-sized rows; the int8-rounded XLA dot
+        otherwise) with straight-through gradients to the f32 master;
+        plain einsum otherwise.  Tensor-parallel meshes keep the dense
+        path — the pallas kernel carries no GSPMD rule (the
+        ``_q8_kernel_mode`` gate)."""
+        if (not self.int8_matmul or self._is_q8(w)
+                or not jnp.issubdtype(w.dtype, jnp.floating)):
+            return jnp.einsum("bsd,df->bsf", x, self._wt(w, dt))
+        from ..ops import quant
+        b, s, din = x.shape
+        mode = self._q8_kernel_mode()
+        if mode is not None and not quant.supported(b * s, din,
+                                                    w.shape[1]):
+            mode = None  # int8-rounded XLA dot; values identical
+        out = _int8_ste_matmul(mode, x.reshape(b * s, din).astype(dt), w)
+        return out.reshape(b, s, w.shape[1])
+
     def _block(self, h, layer_params, positions, return_kv: bool = False,
                dropout_rng=None):
         cfg = self.cfg
@@ -379,12 +458,11 @@ class GPT(TpuModule):
                              compute_dtype=dt, mesh=self.mesh)
         else:
             aux = jnp.zeros((), jnp.float32)
-            up = jax.nn.gelu(
-                jnp.einsum("bsd,df->bsf", x, self._wt(m["wi"], dt)))
+            up = jax.nn.gelu(self._mlp_train_matmul(x, m["wi"], dt))
             up = self._constrain(up, mesh_lib.BATCH_AXES,
                                  mesh_lib.SEQUENCE_AXIS,
                                  mesh_lib.TENSOR_AXIS)
-            y = jnp.einsum("bsf,fd->bsd", up, self._wt(m["wo"], dt))
+            y = self._mlp_train_matmul(up, m["wo"], dt)
         if dropout_rng is not None and cfg.dropout > 0:
             y = self._dropout(y, dropout_rng)
         h = h + y
@@ -412,11 +490,24 @@ class GPT(TpuModule):
             # positions derive from the (static) seq length; recomputed here
             # so the pipeline stage body closes over no outer-context tracers
             pos = jnp.arange(h_in.shape[1])
+            # overlap-aware FSDP (Trainer(gather_mode="scan")): inside the
+            # scan-gather train-step trace this hook all-gathers ONE
+            # layer's bf16 shards at the top of the scan body — XLA
+            # overlaps layer k+1's gather with layer k's matmuls, and the
+            # gather's autodiff transpose reduce-scatters the layer's
+            # gradient into its shard owner inside the backward.  It sits
+            # INSIDE the remat body, so a policy that drops the gathered
+            # weights re-gathers layer-by-layer in the backward instead
+            # of holding the replicated tree live.  None outside that
+            # trace (eval/decode/pipeline see plain params).
+            gather = collectives_lib.current_layer_gather("layers")
 
             if dropout_rng is not None:
                 # rng rides the scan carry; each layer folds off its key
                 def block_do(carry, layer_params):
                     h_c, r = carry
+                    if gather is not None:
+                        layer_params = gather(layer_params)
                     r, sub = jax.random.split(r)
                     h_new, aux = self._block(h_c, layer_params, pos,
                                              dropout_rng=sub)
@@ -430,6 +521,8 @@ class GPT(TpuModule):
                 return out, jnp.sum(aux_per_layer)
 
             def block(carry, layer_params):
+                if gather is not None:
+                    layer_params = gather(layer_params)
                 return self._block(carry, layer_params, pos)
 
             if self.cfg.remat:

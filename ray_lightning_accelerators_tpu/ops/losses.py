@@ -203,10 +203,20 @@ def fused_linear_cross_entropy(h: jax.Array, w: jax.Array,
     [chunk_rows, V]; rows are zero-padded to a multiple of it.
 
     When ``mesh`` has sharded data/fsdp axes the op runs under
-    ``jax.shard_map`` so each device streams only its local rows; the row
-    dim of ``h``/``targets`` must then be sharded over exactly those axes.
+    ``shard_map`` so each device streams only its local rows; the row
+    dim of ``h``/``targets`` must then be sharded over exactly those
+    axes.  Already INSIDE a manual (shard_map) trace — the compressed
+    gradient exchange runs the whole model in one — the rows are
+    device-local and the batch axes are bound, so the op streams them
+    directly and psums the scalar sums without nesting another
+    shard_map.
     """
     if mesh is not None and _batch_axes_in(mesh):
+        from ..parallel.sharding import _manual_axes_active
+        axes = _batch_axes_in(mesh)
+        if _manual_axes_active():
+            return _streamed_psum_mean(h, w, targets, chunk_rows, axes,
+                                       label_smoothing, z_loss)
         return _fused_sharded(h, w, targets, chunk_rows, mesh,
                               label_smoothing, z_loss)
     ls, cs, n = _streamed_sums(h, w, targets, chunk_rows, (),
@@ -215,20 +225,34 @@ def fused_linear_cross_entropy(h: jax.Array, w: jax.Array,
     return ls / n, cs / n
 
 
+def _streamed_psum_mean(h_l, w_r, t_l, chunk_rows, axes, label_smoothing,
+                        z_loss):
+    """Local rows -> psum'd mean loss/accuracy (runs with ``axes`` bound:
+    either as a shard_map body or inline inside an enclosing manual
+    trace)."""
+    ls, cs, n = _streamed_sums(h_l, w_r, t_l, chunk_rows, axes,
+                               label_smoothing, z_loss)
+    ls = jax.lax.psum(ls, axes)
+    # accuracy and the valid-row count are not differentiated (only
+    # mean_loss is, per the public contract); jax 0.4.x's shard_map
+    # cannot transpose a psum of a symbolic-Zero cotangent, so cut the
+    # dead AD paths explicitly
+    cs = jax.lax.psum(jax.lax.stop_gradient(cs), axes)
+    n = jnp.maximum(jax.lax.psum(jax.lax.stop_gradient(n), axes), 1.0)
+    return ls / n, cs / n
+
+
 def _fused_sharded(h, w, targets, chunk_rows, mesh, label_smoothing=0.0,
                    z_loss=0.0):
+    from ..parallel.sharding import shard_map_compat
     axes = _batch_axes_in(mesh)
     P = jax.sharding.PartitionSpec
 
     def body(h_l, w_r, t_l):
-        ls, cs, n = _streamed_sums(h_l, w_r, t_l, chunk_rows, axes,
+        return _streamed_psum_mean(h_l, w_r, t_l, chunk_rows, axes,
                                    label_smoothing, z_loss)
-        ls = jax.lax.psum(ls, axes)
-        cs = jax.lax.psum(cs, axes)
-        n = jnp.maximum(jax.lax.psum(n, axes), 1.0)
-        return ls / n, cs / n
 
-    return jax.shard_map(
+    return shard_map_compat(
         body, mesh=mesh,
         in_specs=(P(axes, None), P(None, None), P(axes)),
         out_specs=(P(), P()))(h, w, targets)

@@ -61,6 +61,8 @@ to torch DDP's bucketed fp32 allreduce (ray_lightning/ray_ddp.py:222-237).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
@@ -73,6 +75,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import mesh as mesh_lib
 
 COMPRESSION_MODES = (None, "int8", "bf16")
+
+# how the bf16 compute view of fsdp-sharded params is assembled inside
+# the train step: "tree" all-gathers the WHOLE param tree before the
+# forward (PR 8 — simple, but the gather latency serializes with
+# compute); "scan" keeps the stacked per-layer leaves sharded as scan
+# operands and all-gathers each layer INSIDE the layer scan, so XLA can
+# overlap layer k+1's gather with layer k's matmuls and the backward
+# re-gathers per layer under the remat policy instead of holding the
+# full replicated tree live (the ZeRO-3 latency-hiding schedule)
+GATHER_MODES = ("tree", "scan")
 
 # int8 quantization granularity: one f32 scale per this many elements.
 # 256 keeps scale overhead at 4/256 = 1.6% of payload while staying well
@@ -330,13 +342,28 @@ def fsdp_shard_dim(sharding_or_spec) -> Optional[int]:
     """The one param dim sharded over the ``fsdp`` axis, or None for a
     fully replicated leaf.  Raises :class:`TensorShardedParamsError` for
     any model-parallel (non-fsdp) axis in the spec — the layouts the
-    compressed exchange cannot treat as replicas."""
+    compressed exchange cannot treat as replicas.
+
+    Mesh-aware: a NamedSharding's spec may name model-parallel axes the
+    MESH holds at size 1 (rule-based logical shardings always emit the
+    full axis table — a GPT on a pure data x fsdp mesh still says
+    ``P('layers'->pipeline, 'embed'->fsdp, ...)``).  Size-1 axes shard
+    nothing, so they are ignored; a bare PartitionSpec (no mesh) keeps
+    the strict reading — every named axis counts."""
     spec = getattr(sharding_or_spec, "spec", sharding_or_spec)
+    mesh = getattr(sharding_or_spec, "mesh", None)
+
+    def real(axis: str) -> bool:
+        return mesh is None or mesh_lib.mesh_axis_size(mesh, axis) > 1
+
     dim = None
     for d, entry in enumerate(tuple(spec)):
         if entry is None:
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
+        axes = tuple(a for a in axes if real(a))
+        if not axes:
+            continue
         bad = [a for a in axes if a != mesh_lib.FSDP_AXIS]
         if bad or (mesh_lib.FSDP_AXIS in axes and len(axes) > 1):
             raise TensorShardedParamsError(
@@ -394,18 +421,23 @@ def _leaf_regime(leaf, sharding_or_spec, cfg: ExchangeConfig) -> str:
     return "exact"
 
 
-def fsdp_residual_zeros(params, param_shardings, cfg: ExchangeConfig):
+def fsdp_residual_zeros(params, param_shardings, cfg: ExchangeConfig,
+                        scanned: Tuple[str, ...] = ()):
     """Shard-local error-feedback residuals for the FSDP exchange: a
     stacked ``[n, chunk_pad]`` f32 buffer per reduce-scattered leaf
     (each replica holds its OWNED chunk's error — 1/nf of the leaf, the
     whole point), a full ``[n, size]`` buffer for compressible leaves
     that stayed replicated (they ride the two-phase allreduce, whose EF
-    is sender-complete), and a ``[n, 1]`` placeholder otherwise."""
-    mesh = jax.tree.leaves(param_shardings)[0].mesh
-    n = dp_size(mesh)
-    nf = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
+    is sender-complete), and a ``[n, 1]`` placeholder otherwise.
 
-    def one(p, sh):
+    ``scanned`` (gather_mode='scan'): leaves of the named top-level
+    subtrees never ride the quantized exchange — their gradients are
+    reduce-scattered exactly (bf16 cotangent) by the in-scan gather's
+    autodiff transpose — so they all get the placeholder."""
+
+    def one(p, sh, in_scan=False):
+        if in_scan:
+            return jnp.zeros((n, 1), jnp.float32)
         regime = _leaf_regime(p, sh, cfg)
         if regime == "rs":
             _, chunk_pad = _fsdp_chunk_elems(p.shape, fsdp_shard_dim(sh),
@@ -414,7 +446,16 @@ def fsdp_residual_zeros(params, param_shardings, cfg: ExchangeConfig):
         size = int(np.prod(p.shape)) if regime == "allreduce" else 1
         return jnp.zeros((n, size), jnp.float32)
 
-    return jax.tree.map(one, params, param_shardings)
+    mesh = jax.tree.leaves(param_shardings)[0].mesh
+    n = dp_size(mesh)
+    nf = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
+    if not scanned:
+        return jax.tree.map(one, params, param_shardings)
+    return {
+        k: jax.tree.map(
+            lambda p, sh, _s=(k in scanned): one(p, sh, in_scan=_s),
+            sub, param_shardings[k])
+        for k, sub in params.items()}
 
 
 def _rs_leaf_in_body(g, r, dim, nf, n, data_axes, cfg: ExchangeConfig):
@@ -557,6 +598,203 @@ def build_param_gather(mesh: Mesh, param_shardings):
 
 
 # --------------------------------------------------------------------- #
+# Overlap-aware (scan) param gather: layer-wise all-gather in the scan   #
+# --------------------------------------------------------------------- #
+# The tree gather above assembles the WHOLE bf16 compute view before the
+# forward: the all-gather latency serializes with compute and the full
+# replicated tree stays live through the backward.  The scan gather
+# instead keeps the stacked per-layer param leaves (the model's declared
+# scanned subtrees, e.g. GPT's params["layers"]) fsdp-sharded as scan
+# OPERANDS; each scan iteration all-gathers only its own layer's bf16
+# shards through a hook the model applies at the top of its scan body,
+# so XLA overlaps layer k+1's gather with layer k's matmuls.  The
+# backward's transpose of that gather is a bf16 reduce-scatter
+# (psum_scatter) straight into the shard owner — the gradient reduce
+# over fsdp comes out of autodiff, per layer, overlapped — and under a
+# remat policy that drops the gathered weights the backward re-gathers
+# layer-by-layer instead of holding the replicated tree live.
+
+# trace-time hook registry: build_scan_local_grads enters the scope
+# around value_and_grad so the model's scan body picks up its gather
+# hook DURING the train-step trace only — eval/predict traces (plain
+# GSPMD jits, where a named-axis all_gather would not even bind) happen
+# outside the scope and see None
+_LAYER_GATHER_HOOKS: contextvars.ContextVar = contextvars.ContextVar(
+    "rla_layer_gather_hooks", default=None)
+
+
+@contextlib.contextmanager
+def layer_gather_scope(hooks: Dict[str, Any]):
+    token = _LAYER_GATHER_HOOKS.set(hooks)
+    try:
+        yield
+    finally:
+        _LAYER_GATHER_HOOKS.reset(token)
+
+
+def current_layer_gather(key: str):
+    """The in-scan gather hook for one scanned subtree (or None outside
+    a scan-gather train-step trace)."""
+    hooks = _LAYER_GATHER_HOOKS.get()
+    return None if hooks is None else hooks.get(key)
+
+
+def _split_scanned(tree: Dict[str, Any], scanned: Tuple[str, ...]):
+    """(scanned subtrees, rest) of a top-level dict param tree."""
+    sc = {k: v for k, v in tree.items() if k in scanned}
+    rest = {k: v for k, v in tree.items() if k not in scanned}
+    return sc, rest
+
+
+def validate_scan_gather(param_shardings, scanned: Tuple[str, ...]) -> None:
+    """Typed refusal of layouts the in-scan gather cannot handle: a
+    scanned (stacked) leaf whose fsdp-sharded dim is dim 0 — the layer
+    dim itself — cannot stay a scan operand (each device would hold only
+    a slice of the LAYERS, not of a layer)."""
+    if not isinstance(param_shardings, dict):
+        raise TensorShardedParamsError(
+            "gather_mode='scan' needs a dict param tree with the scanned "
+            f"stacks as top-level keys; got {type(param_shardings).__name__}")
+    missing = [k for k in scanned if k not in param_shardings]
+    if missing:
+        raise TensorShardedParamsError(
+            f"gather_mode='scan': scanned subtree keys {missing} are not "
+            f"top-level param keys {sorted(param_shardings)}")
+    for k in scanned:
+        for s in jax.tree.leaves(param_shardings[k]):
+            if fsdp_shard_dim(s) == 0:
+                raise TensorShardedParamsError(
+                    f"gather_mode='scan': a leaf of scanned subtree {k!r} "
+                    f"is fsdp-sharded on dim 0 (the stacked layer dim); "
+                    f"the layer scan needs every device to hold ALL "
+                    f"layers of its shard — shard a non-layer dim or use "
+                    f"gather_mode='tree'")
+
+
+def build_scan_param_gather(mesh: Mesh, param_shardings,
+                            scanned: Tuple[str, ...]):
+    """The scan-mode compute view: ``(prelude_fn, hooks)``.
+
+    ``prelude_fn(params)`` bf16-all-gathers only the NON-scanned leaves
+    (embeddings, final norm — weights every position touches before the
+    first layer) exactly like ``build_param_gather`` and passes the
+    scanned stacks through UNTOUCHED, still fsdp-sharded.
+
+    ``hooks[key]`` is the per-layer gather the model applies inside its
+    scan body (via ``current_layer_gather``): for each fsdp-sharded leaf
+    of one layer SLICE, cast to bf16, ``all_gather`` over the fsdp axis
+    (at the stacked dim minus the layer dim), cast back — so the gather
+    of layer k+1 overlaps layer k's compute, and its autodiff transpose
+    reduce-scatters the layer's gradient into the shard owner."""
+    validate_scan_gather(param_shardings, scanned)
+    sc_sh, rest_sh = _split_scanned(param_shardings, scanned)
+    rest_gather = build_param_gather(mesh, rest_sh) if rest_sh else None
+
+    def prelude(params):
+        sc, rest = _split_scanned(params, scanned)
+        out = dict(rest_gather(rest)) if rest_gather is not None else {}
+        out.update(sc)
+        return out
+
+    hooks = {}
+    for key in scanned:
+        flat_sh, _ = jax.tree.flatten(sc_sh[key])
+        # dim within one layer SLICE (the scan drops stacked dim 0)
+        slice_dims = [None if fsdp_shard_dim(s) is None
+                      else fsdp_shard_dim(s) - 1 for s in flat_sh]
+
+        def hook(layer_slice, _dims=tuple(slice_dims)):
+            flat, treedef = jax.tree.flatten(layer_slice)
+            outs = []
+            for leaf, d in zip(flat, _dims):
+                if d is None:
+                    outs.append(leaf)
+                    continue
+                wire = (leaf.astype(PARAM_GATHER_DTYPE)
+                        if jnp.issubdtype(leaf.dtype, jnp.floating)
+                        else leaf)
+                g = jax.lax.all_gather(wire, mesh_lib.FSDP_AXIS, axis=d,
+                                       tiled=True)
+                outs.append(g.astype(leaf.dtype))
+            return treedef.unflatten(outs)
+
+        hooks[key] = hook
+    return prelude, hooks
+
+
+def build_scan_local_grads(mesh: Mesh, value_and_grad_fn, batch_spec,
+                           param_shardings, scanned: Tuple[str, ...],
+                           hooks, extra_metrics=None):
+    """Per-replica gradients for the scan-gather step.  The params
+    argument is the PRELUDE's mixed tree: non-scanned leaves replicated
+    (gathered), scanned stacks still fsdp-sharded — they enter the
+    shard_map body as local shards and the model's in-scan hook (bound
+    via ``layer_gather_scope`` for exactly this trace) gathers each
+    layer on use.
+
+    Gradient layouts out of the body:
+
+    - scanned fsdp-sharded leaves: the all-gather's transpose already
+      reduce-scattered the bf16 cotangent into the shard owner (summed
+      over the fsdp group, per layer, inside the overlapped backward);
+      the body folds the pure-data replicas with an exact fp32 psum and
+      divides by n — the finished MEAN gradient in the param layout,
+      nothing left for the quantized exchange to move.
+    - scanned replicated leaves (stacked norm scales): exact psum-mean
+      over all axes (they are tiny).
+    - everything else: raw local grads stacked ``[n, ...]`` — the
+      caller routes them through the usual quantized exchange."""
+    axes = dp_axis_names(mesh)
+    data_axes = tuple(a for a in axes if a != mesh_lib.FSDP_AXIS)
+    n = dp_size(mesh)
+    flat_sh, sh_treedef = jax.tree.flatten(param_shardings)
+    kind_tree = {
+        k: jax.tree.map(
+            (lambda s: "scan_rs" if fsdp_shard_dim(s) is not None
+             else "scan_repl") if k in scanned else (lambda s: "rest"),
+            sub)
+        for k, sub in param_shardings.items()}
+    kinds = jax.tree.leaves(kind_tree)  # congruent tree -> same order
+    param_in_specs = sh_treedef.unflatten(
+        [s.spec if k != "rest" else P()
+         for s, k in zip(flat_sh, kinds)])
+    grad_out_specs = sh_treedef.unflatten(
+        [s.spec if k == "scan_rs" else
+         (P() if k == "scan_repl" else P(mesh_lib.BATCH_AXES))
+         for s, k in zip(flat_sh, kinds)])
+
+    def body(params, batch, rng):
+        # per-replica stochasticity: same fold_in as build_local_grads
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axes))
+        with layer_gather_scope(hooks):
+            (_, metrics), grads = value_and_grad_fn(params, batch, rng)
+        metrics = jax.tree.map(lambda m: jax.lax.pmean(m, axes), metrics)
+        if extra_metrics is not None:
+            metrics.update(extra_metrics(grads))
+        flat_g, g_treedef = jax.tree.flatten(grads)
+        outs = []
+        for g, kind in zip(flat_g, kinds):
+            if kind == "scan_rs":
+                # already fsdp-reduced into the owner by the gather's
+                # transpose; fold cross-data replicas, finish the mean
+                dt = g.dtype
+                g = g.astype(jnp.float32)
+                if data_axes:
+                    g = jax.lax.psum(g, data_axes)
+                outs.append((g / n).astype(dt))
+            elif kind == "scan_repl":
+                outs.append(jax.lax.psum(g.astype(jnp.float32), axes) / n)
+            else:
+                outs.append(g[None])
+        return metrics, g_treedef.unflatten(outs)
+
+    # graftlint: ok(retrace) — builder runs once at compile; reused
+    return shard_map(
+        body, mesh=mesh, in_specs=(param_in_specs, batch_spec, P()),
+        out_specs=(P(), grad_out_specs), check_rep=False)
+
+
+# --------------------------------------------------------------------- #
 # ZeRO-1 optimizer-state sharding                                        #
 # --------------------------------------------------------------------- #
 def zero1_param_sharding(mesh: Mesh, leaf) -> NamedSharding:
@@ -601,7 +839,8 @@ def zero1_update_shardings(mesh: Mesh, params):
 # Wire accounting                                                        #
 # --------------------------------------------------------------------- #
 def wire_bytes_per_step(params, n: int, cfg: ExchangeConfig,
-                        param_shardings=None) -> Dict[str, Any]:
+                        param_shardings=None, gather_mode: str = "tree",
+                        scanned: Tuple[str, ...] = ()) -> Dict[str, Any]:
     """Analytic per-device bytes-on-wire for one gradient exchange.
 
     Ring-allreduce fp32 moves ``2*(N-1)/N * 4 * size`` bytes per device;
@@ -619,12 +858,32 @@ def wire_bytes_per_step(params, n: int, cfg: ExchangeConfig,
     of the updated param (``(nf-1)/nf * 2 * size``).  The fp32 baseline
     column stays the ring allreduce — what replicated DP (or fp32 FSDP,
     whose RS+AG totals the same bytes) would move — so the ratio is the
-    honest apples-to-apples headline."""
+    honest apples-to-apples headline.
+
+    ``gather_mode="scan"`` + ``scanned``: overlap accounting.  Bytes a
+    probe should price as latency are only the ones that SERIALIZE with
+    compute — ``exposed_bytes_per_step``.  Leaves of the scanned
+    subtrees move per layer inside the scan: a bf16 forward all-gather
+    overlapped with the previous layer's matmuls, the bf16 cotangent
+    reduce-scatter the gather's autodiff transpose emits inside the
+    (equally overlapped) backward, and the fp32 cross-data psum of the
+    1/nf reduced shard — all ``hidden_bytes_per_step``.  Everything
+    else (the up-front gather of non-scanned leaves, the post-backward
+    quantized exchange — and the WHOLE tree-mode exchange) is exposed.
+    ``exchange_bytes_per_step`` remains exposed + hidden."""
+    if gather_mode not in GATHER_MODES:
+        raise ValueError(f"gather_mode must be one of {GATHER_MODES}, "
+                         f"got {gather_mode!r}")
     if n <= 1:
         factor = 0.0
     else:
         factor = 2.0 * (n - 1) / n
     flat, treedef = jax.tree.flatten(params)
+    in_scan = [False] * len(flat)
+    if gather_mode == "scan" and scanned and isinstance(params, dict):
+        in_scan = jax.tree.leaves({
+            k: jax.tree.map(lambda _: k in scanned, sub)
+            for k, sub in params.items()})
     if param_shardings is not None:
         flat_sh = treedef.flatten_up_to(param_shardings)
         mesh = flat_sh[0].mesh
@@ -637,15 +896,33 @@ def wire_bytes_per_step(params, n: int, cfg: ExchangeConfig,
     data_factor = 0.0 if nd <= 1 else 2.0 * (nd - 1) / nd
     base_total = comp_base = 0.0
     exch_total = comp_exch = 0.0
-    rs_bytes = ag_bytes = 0.0
+    rs_bytes = ag_bytes = hidden = 0.0
     n_comp = n_fp32 = n_rs = 0
-    for leaf, sh in zip(flat, flat_sh):
+    for leaf, sh, sc in zip(flat, flat_sh, in_scan):
         size = int(np.prod(leaf.shape))
         fp32 = factor * 4.0 * size
         base_total += fp32
         regime = ("allreduce" if sh is None
                   else _leaf_regime(leaf, sh, cfg))
-        if regime == "rs":
+        if regime == "rs" and sc:
+            # in-scan leaf: bf16 fwd all-gather + bf16 cotangent RS (the
+            # gather's transpose) — exact (no quantized exchange) and
+            # overlapped with the scan's compute.  The fp32 cross-data
+            # psum of the 1/nf shard runs in the shard_map body AFTER
+            # the backward (build_scan_local_grads), not inside the
+            # scan, so it serializes like the exposed exchange and is
+            # priced as exposed.
+            n_rs += 1
+            data_psum = data_factor * 4.0 * (size / nf)
+            rs = rs_factor * 2.0 * size + data_psum
+            ag = rs_factor * 2.0 * size
+            rs_bytes += rs
+            ag_bytes += ag
+            exch_total += rs + ag
+            hidden += rs + ag - data_psum
+            comp_base += fp32
+            comp_exch += rs + ag
+        elif regime == "rs":
             n_rs += 1
             _, chunk_pad = _fsdp_chunk_elems(leaf.shape,
                                              fsdp_shard_dim(sh), nf, cfg)
@@ -679,8 +956,13 @@ def wire_bytes_per_step(params, n: int, cfg: ExchangeConfig,
         "mode": cfg.mode, "block": cfg.block, "devices": n,
         "regime": ("reduce_scatter_all_gather" if n_rs
                    else "allreduce"),
+        "gather_mode": gather_mode if n_rs else None,
         "baseline_fp32_bytes_per_step": int(base_total),
         "exchange_bytes_per_step": int(exch_total),
+        # derived from the two truncated fields so the documented
+        # exposed + hidden == exchange invariant holds exactly
+        "exposed_bytes_per_step": int(exch_total) - int(hidden),
+        "hidden_bytes_per_step": int(hidden),
         "compression_ratio": round(ratio, 3),
         "compressed_ratio": round(comp_ratio, 3),
         "compressed_leaves": n_comp + n_rs, "fp32_leaves": n_fp32,

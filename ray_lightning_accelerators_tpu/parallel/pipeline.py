@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_lib
+from . import sharding as sharding_lib
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -83,7 +84,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
             jnp.where(stage == S - 1, outbuf, jnp.zeros_like(outbuf)), axis)
         return outbuf.reshape(b, *x_full.shape[1:])
 
-    return jax.shard_map(
+    return sharding_lib.shard_map_compat(
         body, mesh=mesh, axis_names={axis},
         in_specs=(P(axis), P()),   # stage dim manual; rest auto-propagated
         out_specs=P(), check_vma=False)(stage_params, x)

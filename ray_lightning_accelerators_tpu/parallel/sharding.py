@@ -97,6 +97,49 @@ def validate_shardings(params, shardings, mesh: Mesh) -> None:
     jax.tree_util.tree_map_with_path(check, params, shardings)
 
 
+def shard_map_compat(*args, **kwargs):
+    """``jax.shard_map`` where it exists (0.5+), the experimental import
+    on 0.4.x — one spelling for every call site.  The replication-check
+    kwarg renamed across that boundary too (``check_rep`` ->
+    ``check_vma``); translate whichever the caller used."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if "axis_names" in kwargs:
+            # partial-manual spelling flipped: new jax names the MANUAL
+            # axes, 0.4.x names the AUTO remainder
+            manual = frozenset(kwargs.pop("axis_names"))
+            kwargs["auto"] = (frozenset(kwargs["mesh"].axis_names)
+                              - manual)
+    elif "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return fn(*args, **kwargs)
+
+
+def _manual_axes_active() -> bool:
+    """True while tracing inside a shard_map body (manual mesh axes).
+
+    Newer jax exposes the ambient abstract mesh; 0.4.x has neither
+    ``get_abstract_mesh`` nor bare-spec constraints, but a shard_map
+    body there extends the axis env — any bound axis name means manual
+    context."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is not None:
+        ambient = get()
+        # `_any_axis_manual` is private jax API (0.9.x); degrade to the
+        # plain-jit path if a future jax renames it rather than crashing
+        # every forward
+        return (not ambient.empty) and getattr(ambient,
+                                               "_any_axis_manual", False)
+    try:
+        from jax._src import core as _core
+        return bool(_core.unsafe_get_axis_names())
+    except Exception:
+        return False
+
+
 def shard_constraint(x, mesh: Mesh, spec: P):
     """with_sharding_constraint that adapts to the tracing context.
 
@@ -106,12 +149,17 @@ def shard_constraint(x, mesh: Mesh, spec: P):
     NamedSharding over the concrete mesh is accepted at trace time there but
     fails at lowering.  Context is detected explicitly so genuinely broken
     specs still raise instead of silently no-op'ing.
+
+    jax 0.4.x: there is no abstract mesh and bare-spec constraints are
+    rejected outright ("requires a non-empty mesh"); inside a manual body
+    the values are device-local and GSPMD constraints carry no meaning
+    there, so the manual branch degrades to identity instead of a
+    guaranteed lowering error.
     """
-    ambient = jax.sharding.get_abstract_mesh()
-    # `_any_axis_manual` is private jax API (0.9.x); degrade to the plain-jit
-    # path if a future jax renames it rather than crashing every forward
-    if not ambient.empty and getattr(ambient, "_any_axis_manual", False):
-        return jax.lax.with_sharding_constraint(x, spec)
+    if _manual_axes_active():
+        if getattr(jax.sharding, "get_abstract_mesh", None) is not None:
+            return jax.lax.with_sharding_constraint(x, spec)
+        return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 

@@ -6,7 +6,8 @@ callbacks (the reference's `from ray_lightning.tune import TuneReportCallback`).
 """
 
 from .callbacks import TuneReportCallback, TuneReportCheckpointCallback
-from .run import (ExperimentAnalysis, Trial, checkpoint_payload,
+from .run import (ExperimentAnalysis, Trial, autotune_step,
+                  checkpoint_payload, default_step_space,
                   is_session_enabled, report, run, trial_devices,
                   trial_should_stop)
 from .schedulers import (ASHAScheduler, FIFOScheduler, MedianStoppingRule,
@@ -15,7 +16,8 @@ from .search import (TPESearcher, choice, grid_search, loguniform, randint,
                      uniform)
 
 __all__ = [
-    "run", "report", "checkpoint_payload", "is_session_enabled",
+    "run", "autotune_step", "default_step_space",
+    "report", "checkpoint_payload", "is_session_enabled",
     "trial_should_stop", "trial_devices",
     "ExperimentAnalysis", "Trial",
     "choice", "uniform", "loguniform", "randint", "grid_search",

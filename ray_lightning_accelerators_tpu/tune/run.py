@@ -17,6 +17,7 @@ architecture so the same callbacks work over the subprocess/actor executors.
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -479,6 +480,96 @@ def _run_trials_in_processes(trainable, trials, scheduler,
         server.close()
     if failures:
         raise failures[0]
+
+
+# default train-step autotuning space: the three step-shape knobs the
+# MFU ladder (BASELINE.md / scripts/mfu_sweep.py) showed move step time
+# on real hardware — what the rematerialized backward may keep, the
+# flash-attention tile shape, and (new) how the FSDP compute view is
+# assembled (whole-tree up-front vs overlapped layer-wise in the scan)
+def default_step_space() -> Dict[str, Any]:
+    from .search import choice
+    return {
+        "remat_policy": choice(["none", "nothing", "dots",
+                                "dots_with_no_batch_dims"]),
+        "flash_block_q": choice([128, 256, 512, 1024]),
+        "flash_block_k": choice([128, 256, 512, 1024]),
+        "gather_mode": choice(["tree", "scan"]),
+    }
+
+
+def autotune_step(measure: Callable[[Dict[str, Any]], float],
+                  space: Optional[Dict[str, Any]] = None,
+                  default_config: Optional[Dict[str, Any]] = None,
+                  n_trials: int = 12,
+                  searcher=None,
+                  seed: int = 0,
+                  verbose: int = 0) -> Dict[str, Any]:
+    """Closed-loop train-step autotuning: the repo's own TPE searcher
+    (tune/search.py) drives the step-shape knobs — remat policy, flash
+    block sizes, FSDP gather mode — against a MEASURED step time.
+
+    ``measure(config) -> step_time_seconds`` runs one short, honest
+    measurement of a train step under ``config`` (scripts/mfu_sweep.py's
+    variant machinery is the intended implementation: same timed-window
+    / sync discipline as the driver bench).  A measurement that raises
+    records ``inf`` for that trial and the search moves on (a config can
+    legitimately be un-compilable — e.g. a flash block exceeding the
+    sequence length).
+
+    The DEFAULT config is measured first and enters the history as trial
+    0, so the returned ``best_config`` can never be slower than the
+    default — the search can only refine it.  Returns::
+
+        {"best_config", "best_step_time_s", "default_step_time_s",
+         "n_trials", "trials": [{"config", "step_time_s"}, ...]}
+    """
+    from .search import TPESearcher
+
+    space = dict(space or default_step_space())
+    default_config = dict(default_config or {
+        "remat_policy": "none", "flash_block_q": 512,
+        "flash_block_k": 512, "gather_mode": "tree"})
+    searcher = searcher or TPESearcher(
+        n_startup=max(2, min(8, n_trials // 2)), seed=seed)
+    searcher.set_search_properties("step_time_s", "min")
+
+    trials: List[Dict[str, Any]] = []
+
+    def one(config: Dict[str, Any]) -> float:
+        try:
+            dt = float(measure(dict(config)))
+        except Exception as e:  # an untunable config is a data point,
+            log.warning("autotune_step: config %s failed (%s: %s)",
+                        config, type(e).__name__, e)  # not an abort
+            dt = float("inf")
+        trials.append({"config": dict(config), "step_time_s": dt})
+        if math.isfinite(dt):
+            searcher.record(config, dt)
+        if verbose:
+            log.warning("autotune_step trial %d: %.2f ms  %s",
+                        len(trials), dt * 1e3, config)
+        return dt
+
+    default_dt = one(default_config)
+    for _ in range(max(0, n_trials - 1)):
+        one(searcher.suggest(dict(space)))
+    best = min(trials, key=lambda t: t["step_time_s"])
+    # None (JSON null) rather than inf/NaN when either side failed to
+    # measure: inf/inf is NaN, and json.dumps would emit the
+    # non-standard Infinity/NaN tokens strict consumers reject
+    speedup = (default_dt / best["step_time_s"]
+               if math.isfinite(default_dt)
+               and math.isfinite(best["step_time_s"])
+               and best["step_time_s"] > 0 else None)
+    return {
+        "best_config": dict(best["config"]),
+        "best_step_time_s": best["step_time_s"],
+        "default_step_time_s": default_dt,
+        "speedup_vs_default": speedup,
+        "n_trials": len(trials),
+        "trials": trials,
+    }
 
 
 def run(trainable: Callable[[Dict[str, Any]], Any],

@@ -53,7 +53,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
                                                            capsys):
     """main() with a dead backend: the death record comes FIRST, no
     accelerator bench ever ran -- and the CPU-mesh fallback benches
-    (gradexchange/input_pipeline/fsdp_exchange/paged_serve)
+    (gradexchange/input_pipeline/fsdp_exchange/paged_serve/
+    mfu_overlap)
     still land REAL metric lines next
     to the death record, so the window exits 0 and the driver records
     numbers (all five earlier BENCH rounds were rc=2 with zero real
@@ -85,19 +86,24 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_paged_serve",
         lambda: {"metric": "paged_serve_concurrency_per_hbm_ratio",
                  "value": 3.9, "unit": "x", "vs_baseline": 2.6})
+    monkeypatch.setattr(
+        bench, "bench_mfu_overlap",
+        lambda: {"metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
+                 "value": 1.3, "unit": "x", "vs_baseline": 1.3})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 5
+    assert len(lines) == 6
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
     assert lines[2]["metric"] == "input_pipeline_prefetch_speedup"
     assert lines[3]["metric"] == "fsdp_exchange_int8_wire_bytes_reduction"
     assert lines[4]["metric"] == "paged_serve_concurrency_per_hbm_ratio"
+    assert lines[5]["metric"] == "mfu_overlap_scan_vs_tree_step_time_ratio"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -111,7 +117,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert [r["metric"] for r in lines2] == [
         "backend_probe", "input_pipeline_prefetch_speedup",
         "fsdp_exchange_int8_wire_bytes_reduction",
-        "paged_serve_concurrency_per_hbm_ratio"]
+        "paged_serve_concurrency_per_hbm_ratio",
+        "mfu_overlap_scan_vs_tree_step_time_ratio"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -120,6 +127,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_fsdp_exchange",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_paged_serve",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_mfu_overlap",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -160,6 +169,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_paged_serve",
         lambda: {"metric": "paged_serve_concurrency_per_hbm_ratio",
                  "value": 3.9, "unit": "x", "vs_baseline": 2.6})
+    monkeypatch.setattr(
+        bench, "bench_mfu_overlap",
+        lambda: {"metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
+                 "value": 1.3, "unit": "x", "vs_baseline": 1.3})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -173,7 +186,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "gradexchange_int8_wire_bytes_reduction",
         "input_pipeline_prefetch_speedup",
         "fsdp_exchange_int8_wire_bytes_reduction",
-        "paged_serve_concurrency_per_hbm_ratio"]
+        "paged_serve_concurrency_per_hbm_ratio",
+        "mfu_overlap_scan_vs_tree_step_time_ratio"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -274,6 +288,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_paged_serve",
         lambda: {"metric": "paged_serve_concurrency_per_hbm_ratio",
                  "value": 3.9, "unit": "x", "vs_baseline": 2.6})
+    monkeypatch.setattr(
+        bench, "bench_mfu_overlap",
+        lambda: {"metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
+                 "value": 1.3, "unit": "x", "vs_baseline": 1.3})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -287,6 +305,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "input_pipeline_prefetch_speedup" in metrics
     assert "fsdp_exchange_int8_wire_bytes_reduction" in metrics
     assert "paged_serve_concurrency_per_hbm_ratio" in metrics
+    assert "mfu_overlap_scan_vs_tree_step_time_ratio" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 
