@@ -82,6 +82,67 @@ def _terminate(proc) -> str:
     return out or ""
 
 
+def _flight_diagnosis(child_out: str, child_err: str,
+                      timed_out: bool = False) -> dict:
+    """Wedge-vs-dead triage embedded in the ``backend_probe`` record, so
+    the BENCH JSON alone distinguishes a wedged device tunnel from a
+    plainly dead backend.  Stdlib-only by design: it reads the
+    flight-recorder SPILL FILES (``RLA_TPU_TELEMETRY_DIR``) directly —
+    this very record is written precisely when importing/initializing
+    jax is what hangs.
+
+    - ``stall``: classification from the probe child's own output — a
+      child that printed NOTHING before the timeout hung inside backend
+      init (the wedged-tunnel shape: the device claim never returns);
+      one that produced output reached python and then stalled/failed
+      (a dead or mid-run-dying backend).
+    - ``flight_tail``: the last events of every rank's spill file from
+      the most recent run on this machine (empty when no telemetry dir
+      is configured) — the driver-side breadcrumb trail of whatever ran
+      last against this backend."""
+    produced = bool((child_out or "").strip() or (child_err or "").strip())
+    # the wedge verdict needs BOTH signals: only a child that ran out
+    # its whole timeout without producing anything looks like a hung
+    # device claim — a fast silent death (segfault/OOM on import) is a
+    # dead backend, not a wedge
+    if timed_out and not produced:
+        cls, detail = "wedged-tunnel", (
+            "probe child produced no output before the timeout: hung "
+            "inside backend init (device claim never returned)")
+    elif timed_out:
+        cls, detail = "dead-backend", (
+            "probe child reached python and produced output before "
+            "stalling past the timeout: backend answered, then died")
+    else:
+        cls, detail = "dead-backend", (
+            "probe child exited promptly"
+            + ("" if produced else " with no output (killed during "
+               "init? segfault/OOM)")
+            + ": backend failed rather than hung")
+    diag: dict = {"stall": {
+        "classification": cls,
+        "detail": detail,
+        "child_output_tail": ((child_err or "") + (child_out or ""))[-300:],
+    }}
+    tdir = os.environ.get("RLA_TPU_TELEMETRY_DIR")
+    tails = {}
+    if tdir and os.path.isdir(tdir):
+        for fname in sorted(os.listdir(tdir)):
+            if not fname.endswith(".events.json"):
+                continue
+            try:
+                with open(os.path.join(tdir, fname)) as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                continue  # torn mid-write: expected near a crash
+            if isinstance(snap, dict):
+                label = fname[:-len(".events.json")]
+                tails[label] = (snap.get("events") or [])[-8:]
+    if tails:
+        diag["flight_tail"] = tails
+    return diag
+
+
 def _death_record(detail: str, failed_bench: str, probe_err: dict) -> str:
     return json.dumps(
         {"metric": "backend_probe", "value": 0, "unit": "alive",
@@ -107,16 +168,20 @@ def probe_backend(timeout_s: float) -> dict | None:
     try:
         out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        _terminate(proc)
+        partial = _terminate(proc)
+        # wedge-vs-dead triage + flight-recorder tail, embedded so the
+        # BENCH JSON alone says WHICH failure mode this window hit
         return {"error": "backend unavailable",
                 "detail": f"device probe hung > {timeout_s:.0f}s "
                           "(wedged tunnel?)",
-                "probe_seconds": round(time.perf_counter() - t0, 1)}
+                "probe_seconds": round(time.perf_counter() - t0, 1),
+                **_flight_diagnosis(partial, "", timed_out=True)}
     if proc.returncode != 0 or "PROBE_OK" not in out:
         tail = (err or out).strip().splitlines()[-3:]
         return {"error": "backend unavailable",
                 "detail": " | ".join(tail)[-500:],
-                "probe_seconds": round(time.perf_counter() - t0, 1)}
+                "probe_seconds": round(time.perf_counter() - t0, 1),
+                **_flight_diagnosis(out, err)}
     return None
 
 
@@ -649,9 +714,19 @@ def bench_mfu_overlap() -> dict:
     inside the transformer scan vs whole-tree up-front,
     parallel/collectives.py + the tune.autotune_step closed loop):
     scan/tree step-time ratio under remat + the analytic exposed-comm
-    reduction, on a forced-host-platform 8-device CPU mesh (see
-    ``_run_cpu_probe``)."""
+    reduction AND the measured exposed-comm crosscheck, on a
+    forced-host-platform 8-device CPU mesh (see ``_run_cpu_probe``)."""
     return _run_cpu_probe("mfu_overlap_probe.py", "mfu_overlap")
+
+
+def bench_perf_observatory() -> dict:
+    """Perf-observatory bench (telemetry/perf.py): one 8-dev CPU-mesh
+    training run whose per-step phase timeline, HBM pool ledger and
+    goodput fraction (over an ElasticRunner run with one injected
+    preemption) all land in a ``run_report.json`` + Prometheus export;
+    the headline value is the named-phase coverage of measured step
+    wall time (see ``_run_cpu_probe``)."""
+    return _run_cpu_probe("perf_observatory_probe.py", "perf_observatory")
 
 
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
@@ -659,7 +734,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "input_pipeline": bench_input_pipeline,
            "fsdp_exchange": bench_fsdp_exchange,
            "paged_serve": bench_paged_serve,
-           "mfu_overlap": bench_mfu_overlap}
+           "mfu_overlap": bench_mfu_overlap,
+           "perf_observatory": bench_perf_observatory}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -683,7 +759,8 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 # subprocess: they cannot be taken down by a dead accelerator backend,
 # so they double as the probe-failure fallback set
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
-                         "fsdp_exchange", "paged_serve", "mfu_overlap")
+                         "fsdp_exchange", "paged_serve", "mfu_overlap",
+                         "perf_observatory")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -786,8 +863,19 @@ def main() -> None:
     parser.add_argument(
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
-                "fsdp_exchange,paged_serve,mfu_overlap",
+                "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory",
         help=f"comma-separated subset of {sorted(BENCHES)}")
+    parser.add_argument("--gate", action="store_true",
+                        help="run no benches: gate a bench window "
+                             "against PERF_BASELINE.json floors "
+                             "(scripts/perf_gate.py) and exit 0 pass / "
+                             "1 regression / 2 UNGATED (no numbers)")
+    parser.add_argument("--gate-input", default=None,
+                        help="window to gate: bench stdout capture or "
+                             "BENCH_r*.json; '-' = stdin (default: "
+                             "newest committed BENCH_r*.json)")
+    parser.add_argument("--gate-baseline", default=None,
+                        help="floors file (default: PERF_BASELINE.json)")
     parser.add_argument("--probe-timeout", type=float,
                         default=float(os.environ.get(
                             "RLA_TPU_PROBE_TIMEOUT", "120")),
@@ -804,6 +892,15 @@ def main() -> None:
                         help="per-bench wall-clock limit in isolated "
                              "mode (seconds)")
     args = parser.parse_args()
+    if args.gate:
+        # regression gate: stdlib-only (scripts/perf_gate.py never
+        # imports jax — it must run on the machine whose backend died)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import perf_gate
+        sys.exit(perf_gate.run(
+            args.gate_input,
+            args.gate_baseline or perf_gate.DEFAULT_BASELINE))
     if args.probe_timeout > 0:
         err = probe_backend(args.probe_timeout)
         if err is not None:

@@ -38,3 +38,20 @@ fi
 # retrace hazards, knob/wire registry drift) — exits nonzero on findings
 python scripts/graftlint.py ray_lightning_accelerators_tpu
 echo "format.sh: graftlint clean"
+
+# perf gate: the newest bench window vs PERF_BASELINE.json floors
+# (scripts/perf_gate.py).  rc 1 = a gated metric regressed -> fail here,
+# where lint fails.  rc 2 = UNGATED (dead-backend/zero-numbers window):
+# reported loudly, not fatal — a wedged tunnel must not block lint.
+set +e
+python bench.py --gate
+gate_rc=$?
+set -e
+if [[ $gate_rc -eq 1 ]]; then
+    echo "format.sh: perf gate REGRESSION (see report above)"
+    exit 1
+elif [[ $gate_rc -eq 2 ]]; then
+    echo "format.sh: perf gate UNGATED — newest window has no gateable numbers"
+else
+    echo "format.sh: perf gate clean"
+fi

@@ -28,7 +28,8 @@ from . import models  # lazy family exports (models/__init__.py PEP 562)
 from . import serve
 from . import telemetry
 from .serve import ServeEngine, ServeReplicas
-from .telemetry import FlightRecorder, MetricsRegistry
+from .telemetry import (FlightRecorder, MetricsRegistry,
+                        PerfObservatory)
 from . import tune
 from .tune import TuneReportCallback, TuneReportCheckpointCallback
 from .utils import schedules
@@ -51,5 +52,6 @@ __all__ = [
     "models", "schedules",
     "serve", "ServeEngine", "ServeReplicas",
     "telemetry", "FlightRecorder", "MetricsRegistry",
+    "PerfObservatory",
     "tune", "TuneReportCallback", "TuneReportCheckpointCallback",
 ]

@@ -34,13 +34,19 @@ BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _lock = threading.Lock()
 _installed = False
 _count = 0
+_seconds = 0.0
 
 
 def _on_event_duration(event: str, *args, **kwargs) -> None:
-    global _count
+    global _count, _seconds
     if event == BACKEND_COMPILE_EVENT:
         with _lock:
             _count += 1
+            if args:  # the duration listener's second positional arg
+                try:
+                    _seconds += float(args[0])
+                except (TypeError, ValueError):
+                    pass  # count stays exact even if a build changes shape
 
 
 def install() -> None:
@@ -69,6 +75,16 @@ def compile_count() -> int:
     install()
     with _lock:
         return _count
+
+
+def compile_seconds() -> float:
+    """Cumulative seconds spent in backend compiles since ``install()``
+    (monotonic, same listener as ``compile_count``).  The perf
+    observatory's step timeline snapshots this at step boundaries to
+    split compile time out of a warmup step's dispatch phase."""
+    install()
+    with _lock:
+        return _seconds
 
 
 class CompileBudgetExceeded(AssertionError):

@@ -128,6 +128,19 @@ _register(Knob("RLA_TPU_LOG_JSON", "bool", False,
 _register(Knob("RLA_TPU_LOG_LEVEL", "str", "WARNING",
                "package logger level; unknown names warn and default "
                "(utils/logging.py)"))
+_register(Knob("RLA_TPU_PERF_HBM_SAMPLE_S", "float", 2.0,
+               "minimum seconds between HBM-ledger pool samples; the "
+               "per-step seam is a no-op inside the window "
+               "(telemetry/perf.py)"))
+_register(Knob("RLA_TPU_PERF_LEAK_MIN_BYTES", "int", 33554432,
+               "total placed-bytes growth a leak streak must reach "
+               "before the hbm_leak event fires (telemetry/perf.py)"))
+_register(Knob("RLA_TPU_PERF_LEAK_SAMPLES", "int", 8,
+               "consecutive growing HBM samples before the leak alarm "
+               "arms (telemetry/perf.py)"))
+_register(Knob("RLA_TPU_PERF_TIMELINE_RING", "int", 64,
+               "per-step phase-timeline ring capacity in recent-step "
+               "rows (telemetry/perf.py)"))
 _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
                "multi-process drain-consensus cadence in steps "
                "(core/trainer.py)"))

@@ -355,6 +355,14 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     # the flight recorder's emit runs inside every other hot root: it
     # must never host-sync or allocate unboundedly (telemetry/)
     "telemetry/recorder.py": ("FlightRecorder.emit",),
+    # the perf observatory's sampling seams run inside the fit loop's
+    # step bracket (and the serve loop): the phase hooks and the
+    # throttled HBM sample must stay host-scalar/metadata-only — one
+    # stray device read here would bill a sync to every step it
+    # claims to measure
+    "telemetry/perf.py": ("StepTimeline.step_end",
+                          "StepTimeline.observe",
+                          "HbmLedger.maybe_sample", "HbmLedger.sample"),
     # the compressed-FSDP exchange + param gathers are compiled INTO the
     # train step: their builders (and shard_map bodies) must stay
     # host-sync-free and build no jits in loops.  The scan-gather pair

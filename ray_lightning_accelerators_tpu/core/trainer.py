@@ -29,6 +29,7 @@ from __future__ import annotations
 import itertools
 import os
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -82,6 +83,7 @@ class Trainer:
                  num_sanity_val_steps: int = 0,
                  enable_progress_bar: bool = False,
                  profiler: Optional["Profiler"] = None,
+                 perf_observatory: Any = None,
                  cache_dataset_on_device: Any = "auto",
                  prefetch_batches: int = 2,
                  worker_deadline_s: Optional[float] = None,
@@ -150,6 +152,18 @@ class Trainer:
         self.num_sanity_val_steps = num_sanity_val_steps
         self.enable_progress_bar = enable_progress_bar
         self.profiler = profiler
+        # perf observatory (telemetry/perf.py): True builds one, or pass
+        # a PerfObservatory.  The fit loop brackets every optimizer step
+        # for the phase timeline (h2d / compile / compute / ckpt /
+        # drain, remainder surfaced as `other`), registers the state's
+        # HBM pools (params / opt_state / exchange buffers / device
+        # cache) on the ledger, and samples watermarks off the hot path
+        # (throttled by RLA_TPU_PERF_HBM_SAMPLE_S).  Exported through
+        # build_metrics_registry() -> JSON + Prometheus + run_report.
+        if perf_observatory is True:
+            from ..telemetry.perf import PerfObservatory
+            perf_observatory = PerfObservatory()
+        self.perf = perf_observatory or None
         # device-resident dataset cache: "auto" caches array-backed datasets
         # up to _CACHE_MAX_BYTES; True forces (when eligible), False disables
         self.cache_dataset_on_device = cache_dataset_on_device
@@ -334,15 +348,16 @@ class Trainer:
         return payload
 
     def save_checkpoint(self, filepath: str) -> None:
-        if self.checkpoint_format != "pickle":
-            # every process participates (each writes its own shards)
-            from ..utils import sharded_checkpoint as sharded_lib
-            meta = self.dump_checkpoint(include_state=False)
-            sharded_lib.save_sharded(
-                filepath, self._state, meta,
-                async_save=self.checkpoint_format == "sharded-async")
-        elif jax.process_index() == 0:
-            ckpt_lib.atomic_save(self.dump_checkpoint(), filepath)
+        with self._perf_phase("ckpt"):  # timeline: save cost is a phase
+            if self.checkpoint_format != "pickle":
+                # every process participates (each writes its own shards)
+                from ..utils import sharded_checkpoint as sharded_lib
+                meta = self.dump_checkpoint(include_state=False)
+                sharded_lib.save_sharded(
+                    filepath, self._state, meta,
+                    async_save=self.checkpoint_format == "sharded-async")
+            elif jax.process_index() == 0:
+                ckpt_lib.atomic_save(self.dump_checkpoint(), filepath)
 
     # ------------------------------------------------------------------ #
     # Preemption drain                                                   #
@@ -401,7 +416,8 @@ class Trainer:
             notice.grace_s(), notice.remaining_s() or 0.0)
         telemetry.emit("preempt_drain", step=self.global_step,
                        source=notice.source)
-        path = self._emergency_checkpoint()
+        with self._perf_phase("drain"):  # drain incl. its emergency save
+            path = self._emergency_checkpoint()
         telemetry.emit("emergency_checkpoint", step=self.global_step,
                        path=path)
         self.fitting = False
@@ -880,6 +896,10 @@ class Trainer:
             self.comms_per_step = report
             if self.profiler is not None:
                 self.profiler.record_comms(report)
+            if self.perf is not None:
+                # the timeline export states the analytic exposed/hidden
+                # wire split next to the measured phase times
+                self.perf.timeline.attach_comms(report)
 
     def _build_compressed_train_step(self, module, mesh, batch_sh,
                                      loss_fn_of, apply_grads,
@@ -1240,8 +1260,17 @@ class Trainer:
         if nb:
             idx_mat = self._put_index_matrix(
                 perm[:nb * bs].astype(np.int32).reshape(nb, bs))
+            t_scan = time.perf_counter()
             state, stacked = self._epoch_scan_fn(state, self._device_cache,
                                                  idx_mat)
+            if self.perf is not None:
+                # the scanned epoch is ONE async dispatch — per-step
+                # phases don't exist, so the timeline gets one coarse
+                # nb-step row (dispatch wall; device time lands at the
+                # next sync) and the HBM ledger its throttled sample
+                self.perf.timeline.observe_scan_epoch(
+                    time.perf_counter() - t_scan, nb)
+                self.perf.hbm.maybe_sample()
             first_step = self.global_step
             self.global_step += nb
             self._state = state
@@ -1689,6 +1718,10 @@ class Trainer:
             reg.add_compile_count(rank="driver")
         except BaseException:  # monitoring unavailable: export without it
             pass
+        if self.perf is not None:
+            # perf-observatory ledgers (telemetry/perf.py): step
+            # timeline + HBM pools (+ goodput when one was fed)
+            self.perf.register(reg)
         return reg
 
     def _fit_local(self, module: TpuModule,
@@ -1792,7 +1825,8 @@ class Trainer:
                 log.warning("ckpt_path='last': no checkpoint under %s; "
                             "starting fresh", self.default_root_dir)
         if ckpt_path is not None:
-            state = self._restore(ckpt_path, state)
+            with self._perf_phase("ckpt"):  # restore cost is a phase too
+                state = self._restore(ckpt_path, state)
 
         example_batch = next(iter(train_loader))
         self._check_batch(example_batch)
@@ -1802,6 +1836,8 @@ class Trainer:
         # place state on mesh with its shardings
         state = jax.device_put(state, self._state_shardings)
         self._state = state
+        if self.perf is not None:
+            self._register_hbm_pools()
 
         for c in self.callbacks:
             c.on_fit_start(self, module)
@@ -1856,6 +1892,12 @@ class Trainer:
                     source, self.prefetch_batches, self._place_train_item,
                     self.profiler, name="rla-prefetch-fit")
                 source = pf
+                if self.perf is not None:
+                    # in-flight placed batches are real HBM: attribute
+                    # them (re-registered per epoch — the pipeline is
+                    # rebuilt each time; a closed pipeline reads empty)
+                    self.perf.hbm.register_pool("prefetch",
+                                                pf.placed_bytes)
             try:
                 for batch_idx, (kind, payload) in enumerate(source):
                     if (self.limit_train_batches is not None
@@ -1906,7 +1948,8 @@ class Trainer:
             c.on_fit_end(self, module)
         if self.checkpoint_format == "sharded-async":
             from ..utils import sharded_checkpoint as sharded_lib
-            sharded_lib.wait_until_finished()  # fence in-flight saves
+            with self._perf_phase("ckpt"):  # checkpoint fence
+                sharded_lib.wait_until_finished()  # fence in-flight saves
         self.fitting = False
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
@@ -1914,6 +1957,33 @@ class Trainer:
         telemetry.emit("fit_end", step=self.global_step,
                        epochs=self.epochs_completed,
                        duration_s=round(self.fit_duration_s, 3))
+
+    def _register_hbm_pools(self) -> None:
+        """Bind the perf observatory's HBM ledger to this run's state:
+        per-pool readers over the live ``TrainState`` (params, optimizer
+        state, compressed-exchange buffers) and the device dataset
+        cache.  Readers tolerate released state (0, never a crash) and
+        re-registering on a later fit replaces them.  One eager sample
+        lands the post-placement watermark before the loop starts."""
+        from ..telemetry.perf import tree_nbytes
+        hbm = self.perf.hbm
+
+        def field_bytes(*fields):
+            def read():
+                st = self._state
+                if st is None:
+                    return 0
+                return sum(tree_nbytes(getattr(st, f, None))
+                           for f in fields)
+            return read
+
+        hbm.register_pool("params", field_bytes("params"))
+        hbm.register_pool("opt_state", field_bytes("opt_state"))
+        hbm.register_pool("exchange_buffers",
+                          field_bytes("residual", "grad_accum"))
+        hbm.register_pool("device_cache",
+                          lambda: tree_nbytes(self._device_cache))
+        hbm.sample()
 
     def _fit_step(self, state, kind, payload, pf, module,
                   batch_idx: int):
@@ -1924,43 +1994,55 @@ class Trainer:
         (with ``_run_scanned_epoch``): everything here dispatches async
         — the only device->host materialization is the log-interval-
         gated metrics readback below, and the compile-guard test pins
-        the whole loop to zero retraces after warmup."""
-        if kind == "cached_local":
-            # synchronous path (prefetch off): the pipeline's
-            # _place_train_item does this conversion otherwise
-            with self._span("h2d"):
-                kind, payload = ("cached", self._put_index_row(payload))
-        if kind == "cached":
-            with self._span("train_step") as h:
-                state, train_metrics = self._train_step_cached_fn(
-                    state, self._device_cache, payload)
-                if h is not None:
-                    h.set(train_metrics)
-        else:
-            if pf is None:
-                with self._span("h2d"):
-                    batch = self._put_batch(payload)
+        the whole loop to zero retraces after warmup (perf observatory
+        attached or not).  The step-timeline bracket and the throttled
+        HBM sample are host scalars/metadata only."""
+        tl = self.perf.timeline if self.perf is not None else None
+        if tl is not None:
+            tl.step_begin()
+        try:
+            if kind == "cached_local":
+                # synchronous path (prefetch off): the pipeline's
+                # _place_train_item does this conversion otherwise
+                with self._span("h2d", phase="h2d"):
+                    kind, payload = ("cached",
+                                     self._put_index_row(payload))
+            if kind == "cached":
+                with self._span("train_step", phase="compute") as h:
+                    state, train_metrics = self._train_step_cached_fn(
+                        state, self._device_cache, payload)
+                    if h is not None:
+                        h.set(train_metrics)
             else:
-                batch = payload  # placed by the pipeline
-            with self._span("train_step") as h:
-                state, train_metrics = self._train_step_fn(
-                    state, batch)
-                if h is not None:
-                    h.set(train_metrics)
-        self.global_step += 1
-        self._state = state
-        # flight-recorder step event: host ints only (graftlint pins this
-        # path sync-free; a device value here would also be one)
-        telemetry.emit("train_step", step=self.global_step,
-                       batch=batch_idx, epoch=self.current_epoch)
-        for c in self.callbacks:
-            c.on_train_batch_end(self, module, train_metrics,
-                                 batch_idx)
-        if self.global_step % self.log_every_n_steps == 0:
-            # graftlint: ok(host-sync) — log-interval-gated readback
-            self._log_now({f"{k}": float(v) for k, v in
-                           jax.device_get(train_metrics).items()})  # graftlint: ok(host-sync) — gated above
-        return state, train_metrics
+                if pf is None:
+                    with self._span("h2d", phase="h2d"):
+                        batch = self._put_batch(payload)
+                else:
+                    batch = payload  # placed by the pipeline
+                with self._span("train_step", phase="compute") as h:
+                    state, train_metrics = self._train_step_fn(
+                        state, batch)
+                    if h is not None:
+                        h.set(train_metrics)
+            self.global_step += 1
+            self._state = state
+            # flight-recorder step event: host ints only (graftlint pins
+            # this path sync-free; a device value here would also be one)
+            telemetry.emit("train_step", step=self.global_step,
+                           batch=batch_idx, epoch=self.current_epoch)
+            for c in self.callbacks:
+                c.on_train_batch_end(self, module, train_metrics,
+                                     batch_idx)
+            if self.global_step % self.log_every_n_steps == 0:
+                # graftlint: ok(host-sync) — log-interval-gated readback
+                self._log_now({f"{k}": float(v) for k, v in
+                               jax.device_get(train_metrics).items()})  # graftlint: ok(host-sync) — gated above
+            return state, train_metrics
+        finally:
+            if tl is not None:
+                tl.step_end()
+            if self.perf is not None:
+                self.perf.hbm.maybe_sample()
 
     def _after_train_epoch(self, module, train_metrics) -> None:
         """Epoch epilogue shared by the step loop and the scanned path:
@@ -1982,7 +2064,7 @@ class Trainer:
         if run_val:
             for c in self.callbacks:
                 c.on_validation_start(self, module)
-            with self._span("validation"):
+            with self._span("validation", phase="validation"):
                 val_metrics = self._run_eval(self._val_loader,
                                              self._eval_step_fn,
                                              limit=self.limit_val_batches,
@@ -2016,7 +2098,7 @@ class Trainer:
         early stopping / Tune reporting see mid-epoch metrics."""
         for c in self.callbacks:
             c.on_validation_start(self, module)
-        with self._span("validation"):
+        with self._span("validation", phase="validation"):
             val_metrics = self._run_eval(self._val_loader,
                                          self._eval_step_fn,
                                          limit=self.limit_val_batches,
@@ -2027,12 +2109,37 @@ class Trainer:
         for c in self.callbacks:
             c.on_validation_end(self, module)
 
-    def _span(self, name: str):
+    def _span(self, name: str, phase: Optional[str] = None):
         """Profiler span, or a null context when no profiler is attached
         (XLA async dispatch makes spans the only honest timing surface --
-        SURVEY.md §5.1 build note)."""
-        if self.profiler is not None:
-            return self.profiler.span(name)
+        SURVEY.md §5.1 build note).  ``phase`` additionally feeds the
+        perf observatory's step timeline (one extra perf_counter pair —
+        the <50us/emit budget the overhead test pins)."""
+        tl = self.perf.timeline if self.perf is not None else None
+        if tl is None or phase is None:
+            if self.profiler is not None:
+                return self.profiler.span(name)
+            import contextlib
+            return contextlib.nullcontext()
+        return self._phased_span(name, tl, phase)
+
+    @contextmanager
+    def _phased_span(self, name: str, tl, phase: str):
+        t0 = time.perf_counter()
+        try:
+            if self.profiler is not None:
+                with self.profiler.span(name) as h:
+                    yield h
+            else:
+                yield None
+        finally:
+            tl.observe(phase, time.perf_counter() - t0)
+
+    def _perf_phase(self, phase: str):
+        """Timeline-only phase context (checkpoint saves/restores,
+        preemption drains) — a no-op without an observatory."""
+        if self.perf is not None:
+            return self.perf.timeline.phase(phase)
         import contextlib
         return contextlib.nullcontext()
 

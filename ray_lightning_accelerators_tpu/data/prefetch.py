@@ -250,6 +250,14 @@ class DevicePrefetcher:
         qsize = getattr(self._inner, "qsize", None)
         return qsize is not None and qsize() > 0
 
+    def placed_bytes(self) -> int:
+        """Logical bytes of the device-placed batches currently in
+        flight — the perf observatory's ``prefetch`` HBM pool reader
+        (shape metadata only, never a sync; non-array ring leaves count
+        zero)."""
+        from ..telemetry.perf import tree_nbytes
+        return tree_nbytes(list(self._ring))
+
     def __iter__(self) -> Iterator[Any]:
         return self
 

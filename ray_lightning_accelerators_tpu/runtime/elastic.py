@@ -44,6 +44,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..analysis import knobs
+from ..telemetry import perf as perf_lib
 from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 from . import preemption as preempt_lib
@@ -153,6 +154,12 @@ class ElasticRunner:
         # configured, so a driver SIGTERM ends the retry loop instead of
         # respawning workers on a host that is going away
         self._notice = preempt_lib.install_from_env()
+        # goodput ledger (telemetry/perf.py): the runner accounts the
+        # overheads only the driver can see (restart/boot + backoff,
+        # wedge-detection wait); feed the attempts' interior split via
+        # goodput.absorb_timeline / absorb_profiler and read one
+        # goodput fraction per run from goodput.snapshot()
+        self.goodput = perf_lib.GoodputLedger()
 
     def _write_report(self, exc: BaseException) -> None:
         """Postmortem artifact for a failed/preempted attempt (no-op
@@ -261,15 +268,18 @@ class ElasticRunner:
         attempt = 0
         failures = 0
         preemptions = 0
+        self.goodput.run_begin()
         while True:
             self.attempts_used = attempt + 1
+            self.goodput.note_attempt()
             telemetry.emit("elastic_attempt", attempt=attempt + 1,
                            world_size=len(self.pool))
             if attempt > 0:
                 # restart every rank, not just dead ones: survivors of a
                 # broken collective (and watchdog-reaped wedges' peers)
                 # are alive-but-stuck and would never dequeue the retry
-                self._prepare_retry(attempt, failures)
+                with self.goodput.measure("restart"):
+                    self._prepare_retry(attempt, failures)
             watchdog: Optional[Watchdog] = None
             # built OUTSIDE the try: a mis-sized args_per_worker is a
             # configuration error, not a retryable attempt failure
@@ -297,14 +307,17 @@ class ElasticRunner:
                         # blocking the driver forever
                         hard_deadline = self.dispatch_deadline_s + max(
                             30.0, watchdog.wedge_timeout_s)
-                return process_results(futures, queue,
-                                       deadline_s=hard_deadline)
+                results = process_results(futures, queue,
+                                          deadline_s=hard_deadline)
+                self.goodput.run_end()
+                return results
             except BaseException as e:  # noqa: BLE001 — resurfaced below
                 last_exc = e
                 if preempt_lib.is_preemption(e):
                     # a drained preemption is a RESUME, not a failure:
                     # state is checkpointed, the budget stays intact
                     preempted = preempt_lib.as_preempted(e)
+                    self.goodput.note_preemption()
                     self.preempt_events.append(preempted)
                     telemetry.emit("elastic_preempt_resume",
                                    attempt=attempt + 1,
@@ -342,7 +355,18 @@ class ElasticRunner:
                 if watchdog is not None:
                     watchdog.stop()
                     self.wedge_events.extend(watchdog.reaped)
+                    for rec in watchdog.reaped:
+                        # wedge-detection wait: the run sat behind a
+                        # frozen rank from its last observed progress
+                        # to the reap — the stale-beat age when the
+                        # channel measured it, else the configured
+                        # detection budget
+                        self.goodput.account(
+                            "wedge_wait",
+                            rec.get("beat_age_s")
+                            or watchdog.wedge_timeout_s or 0.0)
             attempt += 1
+        self.goodput.run_end()
         raise RuntimeError(
             f"elastic run failed after {self.max_failures + 1} attempts"
         ) from last_exc

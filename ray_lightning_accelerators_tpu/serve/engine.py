@@ -232,6 +232,7 @@ class ServeEngine:
                  max_new_tokens_cap: Optional[int] = None,
                  prompt_block: int = 8,
                  metrics: Optional[ServeMetrics] = None,
+                 perf_timeline: Any = None,
                  idle_poll_s: float = 0.05,
                  paged: bool = True,
                  block_len: int = 16,
@@ -262,6 +263,11 @@ class ServeEngine:
         self.max_total_len = W
         self.paged = bool(paged)
         self.metrics = metrics or ServeMetrics()
+        # optional telemetry.perf.StepTimeline: the engine loop feeds
+        # its prefill/decode phase times into the same per-step ledger
+        # the trainer uses (phases "prefill"/"decode"; aggregate-only —
+        # the loop has no optimizer-step bracket)
+        self.perf_timeline = perf_timeline
         self._idle_poll_s = idle_poll_s
         self._jax = jax
         # donate the cache/pool operand where donation is real (TPU/GPU):
@@ -746,6 +752,9 @@ class ServeEngine:
         table row, completion timestamp)."""
         jnp = self._jax.numpy
         t_a = time.monotonic()
+        # queue wait = admission -> this slot-join moment; ttft below
+        # is queue_wait + prefill by construction
+        self.metrics.observe_queue_wait(t_a - req.t_submit)
         start = len(shared) * self.block_len
         sfx = req.prompt[start:]
         P = -(-int(sfx.size) // self.block_len) * self.block_len
@@ -764,6 +773,8 @@ class ServeEngine:
         resp.ttft_s = now - req.t_submit
         self.metrics.observe_ttft(resp.ttft_s)
         self.metrics.observe_prefill(now - t_a)
+        if self.perf_timeline is not None:
+            self.perf_timeline.observe("prefill", now - t_a)
         telemetry.emit("serve_prefill", trace=req.trace_id,
                        request=req.request_id, bucket=P, slot=slot,
                        shared_blocks=len(shared),
@@ -782,6 +793,7 @@ class ServeEngine:
                                                     shared, keys, slot=i)
         else:
             t_a = time.monotonic()
+            self.metrics.observe_queue_wait(t_a - req.t_submit)
             P = self._bucket(s0)
             padded = np.zeros((1, P), np.int32)
             padded[0, :s0] = req.prompt
@@ -798,6 +810,8 @@ class ServeEngine:
             resp.ttft_s = now - req.t_submit
             self.metrics.observe_ttft(resp.ttft_s)
             self.metrics.observe_prefill(now - t_a)
+            if self.perf_timeline is not None:
+                self.perf_timeline.observe("prefill", now - t_a)
             telemetry.emit("serve_prefill", trace=req.trace_id,
                            request=req.request_id, bucket=P, slot=i,
                            shared_blocks=0,
@@ -843,6 +857,8 @@ class ServeEngine:
         nxt = np.asarray(toks_next)  # graftlint: ok(host-sync) — feed gate
         now = time.monotonic()
         self.metrics.observe_step(now - t0, len(active))
+        if self.perf_timeline is not None:
+            self.perf_timeline.observe("decode", now - t0)
         # batched event (one per step, not per slot): slot-level identity
         # lives in the admit/prefill/respond events' traces
         telemetry.emit("serve_decode_step", active=len(active),

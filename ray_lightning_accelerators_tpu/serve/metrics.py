@@ -8,6 +8,11 @@ exact max survives reservoir eviction).  Three latency families:
 - **ttft** (time to first token): submit -> first token produced.  In a
   continuous-batching engine this includes queue wait, so it IS the
   admission/backpressure signal.
+- **queue_wait**: submit -> slot-join (the moment prefill starts).
+  ``ttft = queue_wait + prefill`` by construction, so the timeline
+  splits queueing from compute — a fat queue_wait p99 says "add
+  replicas / shed load" where a fat prefill p99 says "the model is
+  slow", which is the serve-tier autoscaling signal.
 - **token_latency**: gap between a request's consecutive tokens.  Under
   continuous batching this tracks the shared step time — it degrades
   gracefully as the batch fills, which is the throughput/latency trade
@@ -37,6 +42,7 @@ class ServeMetrics:
     TOKEN = "serve/token_latency"
     STEP = "serve/decode_step"
     PREFILL = "serve/prefill"
+    QUEUE = "serve/queue_wait"
 
     _COUNTERS = ("submitted", "completed", "failed", "cancelled",
                  "rejected", "requeued", "prefills", "tokens_generated",
@@ -95,6 +101,11 @@ class ServeMetrics:
 
     def observe_ttft(self, dt_s: float) -> None:
         self.profiler.observe(self.TTFT, dt_s)
+
+    def observe_queue_wait(self, dt_s: float) -> None:
+        """Admission -> slot-join wait (recorded the moment the engine
+        starts the request's prefill)."""
+        self.profiler.observe(self.QUEUE, dt_s)
 
     def observe_token_latency(self, dt_s: float) -> None:
         self.profiler.observe(self.TOKEN, dt_s)
@@ -177,6 +188,7 @@ class ServeMetrics:
         out["throughput_tok_s"] = (
             counters["tokens_generated"] / busy_s if busy_s > 0 else 0.0)
         out["ttft_s"] = pct(self.TTFT)
+        out["queue_wait_s"] = pct(self.QUEUE)
         out["token_latency_s"] = pct(self.TOKEN)
         out["decode_step_s"] = pct(self.STEP)
         out["prefill_s"] = pct(self.PREFILL)

@@ -5,12 +5,20 @@ export, and crash postmortem reports.
   propagation and crash-observable spill files;
 - :mod:`.registry` — the driver-side :class:`MetricsRegistry` (merged
   Profiler/ServeMetrics/compile-count export to Prometheus text and
-  JSON) and the ``run_report.json`` postmortem writer.
+  JSON) and the ``run_report.json`` postmortem writer;
+- :mod:`.perf` — the perf observatory: :class:`StepTimeline` (per-step
+  phase decomposition), :class:`HbmLedger` (per-pool HBM attribution +
+  leak alarm) and :class:`GoodputLedger` (run-level wall-time
+  partition), exported through the registry.
 
-See docs/API.md "Telemetry & tracing" for event kinds, propagation
-rules, export formats and the report schema.
+See docs/API.md "Telemetry & tracing" / "Perf observatory" for event
+kinds, phase/pool vocabularies, export formats and the report schema.
 """
 
+from .perf import (GOODPUT_CATEGORIES, PHASE_KINDS, GoodputLedger,
+                   HbmLedger, PerfObservatory, StepTimeline,
+                   exposed_comm_crosscheck, placed_bytes_total,
+                   tree_nbytes)
 from .recorder import (EMBED_TAIL_N, EVENT_KINDS, FlightRecorder,
                        configure, current_rank, current_trace_id, emit,
                        get_recorder, mint_trace_id, read_spill,
@@ -26,4 +34,7 @@ __all__ = [
     "spill_path_for", "read_spill", "tail_events",
     "MetricsRegistry", "gather_worker_tails", "gather_spill_dir",
     "build_run_report", "write_run_report", "probe_snapshot_record",
+    "PerfObservatory", "StepTimeline", "HbmLedger", "GoodputLedger",
+    "PHASE_KINDS", "GOODPUT_CATEGORIES", "exposed_comm_crosscheck",
+    "tree_nbytes", "placed_bytes_total",
 ]

@@ -79,6 +79,9 @@ EVENT_KINDS = frozenset({
     # the optimizer-state mapping) fell back to REPLICATED under
     # use_fsdp — silent loss of FSDP memory savings, surfaced
     "fsdp_fallback",
+    # perf observatory (telemetry/perf.py): the HBM ledger saw placed
+    # bytes grow monotonically for a whole leak streak
+    "hbm_leak",
     # worker dispatch loop (runtime/actors.py)
     "dispatch_begin", "dispatch_end",
     # supervision / retry layers (runtime/watchdog.py, runtime/elastic.py)

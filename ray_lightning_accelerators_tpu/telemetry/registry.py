@@ -63,6 +63,8 @@ class MetricsRegistry:
         self._compile: Dict[str, int] = {}
         self._event_counts: Dict[str, int] = {}
         self._extra: Dict[str, float] = {}
+        # perf-observatory ledgers (telemetry/perf.py snapshots)
+        self._perf: Dict[str, Dict[str, Any]] = {}
 
     @staticmethod
     def _label(rank: Any) -> str:
@@ -116,6 +118,30 @@ class MetricsRegistry:
         """A free-form run-level scalar (probe extras)."""
         self._extra[str(name)] = float(value)
 
+    # -- perf-observatory ledgers (telemetry/perf.py) ------------------- #
+    @staticmethod
+    def _snap(obj: Any) -> Dict[str, Any]:
+        return dict(obj.snapshot()) if hasattr(obj, "snapshot") \
+            else dict(obj)
+
+    def add_step_timeline(self, timeline: Any) -> None:
+        """A :class:`~.perf.StepTimeline` (or its snapshot dict): the
+        per-step phase decomposition of the run's hot loop."""
+        if timeline is not None:
+            self._perf["step_timeline"] = self._snap(timeline)
+
+    def add_hbm(self, ledger: Any) -> None:
+        """A :class:`~.perf.HbmLedger` (or snapshot): per-pool device
+        memory attribution + watermarks + leak-alarm count."""
+        if ledger is not None:
+            self._perf["hbm"] = self._snap(ledger)
+
+    def add_goodput(self, ledger: Any) -> None:
+        """A :class:`~.perf.GoodputLedger` (or snapshot): the run's
+        wall-time partition and goodput fraction."""
+        if ledger is not None:
+            self._perf["goodput"] = self._snap(ledger)
+
     def merged_profiler(self) -> Profiler:
         return self._profiler
 
@@ -140,6 +166,8 @@ class MetricsRegistry:
                             self._compile.values())},
             "events": dict(self._event_counts),
         }
+        if self._perf:
+            out["perf"] = {k: dict(v) for k, v in self._perf.items()}
         if self._extra:
             out["extra"] = dict(self._extra)
         return out
@@ -217,6 +245,50 @@ class MetricsRegistry:
         for kind, n in sorted(self._event_counts.items()):
             add("rla_tpu_events_total", n,
                 f'{{kind="{_prom_name(kind)}"}}', mtype="counter")
+        # perf-observatory ledgers: phase seconds, HBM pools, goodput —
+        # each family key-major like the serve block (exposition format
+        # forbids interleaved families)
+        tl = self._perf.get("step_timeline")
+        if tl:
+            add("rla_tpu_steps_total", tl.get("steps"), mtype="counter")
+            add("rla_tpu_step_wall_seconds_total",
+                tl.get("step_wall_total_s"), mtype="counter")
+            for fam in ("phases", "between_step_phases"):
+                suffix = "" if fam == "phases" else "_between_step"
+                for phase, row in sorted((tl.get(fam) or {}).items()):
+                    add(f"rla_tpu_step_phase{suffix}_seconds_total",
+                        row.get("total_s"),
+                        f'{{phase="{_prom_name(phase)}"}}',
+                        mtype="counter")
+            add("rla_tpu_step_phase_attributed_fraction",
+                tl.get("attributed_fraction"), mtype="gauge")
+            add("rla_tpu_step_exposed_comm_fraction_analytic",
+                tl.get("analytic_exposed_comm_fraction"), mtype="gauge")
+        hbm = self._perf.get("hbm")
+        if hbm:
+            for pool, row in sorted((hbm.get("pools") or {}).items()):
+                add("rla_tpu_hbm_pool_bytes", row.get("bytes"),
+                    f'{{pool="{_prom_name(pool)}"}}', mtype="gauge")
+            for pool, row in sorted((hbm.get("pools") or {}).items()):
+                add("rla_tpu_hbm_pool_peak_bytes", row.get("peak_bytes"),
+                    f'{{pool="{_prom_name(pool)}"}}', mtype="gauge")
+            add("rla_tpu_hbm_total_bytes", hbm.get("total_bytes"),
+                mtype="gauge")
+            add("rla_tpu_hbm_peak_total_bytes",
+                hbm.get("peak_total_bytes"), mtype="gauge")
+            add("rla_tpu_hbm_attributed_fraction",
+                hbm.get("attributed_fraction"), mtype="gauge")
+            add("rla_tpu_hbm_leak_alarms_total", hbm.get("leak_alarms"),
+                mtype="counter")
+        gp = self._perf.get("goodput")
+        if gp:
+            for cat, secs in sorted((gp.get("seconds") or {}).items()):
+                add("rla_tpu_goodput_seconds_total", secs,
+                    f'{{category="{_prom_name(cat)}"}}', mtype="counter")
+            add("rla_tpu_goodput_wall_seconds", gp.get("wall_s"),
+                mtype="gauge")
+            add("rla_tpu_goodput_fraction", gp.get("goodput_fraction"),
+                mtype="gauge")
         for name, v in sorted(self._extra.items()):
             add(f"rla_tpu_{_prom_name(name)}", v, mtype="gauge")
         return "\n".join(lines) + ("\n" if lines else "")

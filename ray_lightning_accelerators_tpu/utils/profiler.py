@@ -495,7 +495,10 @@ def trace_op_summary(trace_dir: str, top: int = 0) -> Dict[str, Any]:
 def device_memory_stats() -> List[Dict[str, Any]]:
     """Per-device PjRt memory counters (bytes_in_use, peak, limit...).
 
-    Empty dicts on backends that don't expose stats (CPU)."""
+    Empty dicts on backends that don't expose stats (CPU).  The perf
+    observatory's HBM ledger (telemetry/perf.py) builds per-pool
+    attribution on top: ``device_bytes_in_use()`` below is its ground
+    truth where the backend reports real HBM."""
     import jax
 
     out = []
@@ -505,6 +508,15 @@ def device_memory_stats() -> List[Dict[str, Any]]:
         except Exception:
             out.append({})
     return out
+
+
+def device_bytes_in_use() -> Optional[int]:
+    """Summed PjRt ``bytes_in_use`` across local devices, or None on
+    backends that expose no memory stats (CPU) — callers fall back to
+    live-array accounting (``telemetry.perf.placed_bytes_total``)."""
+    vals = [s.get("bytes_in_use") for s in device_memory_stats()
+            if s.get("bytes_in_use")]
+    return int(sum(vals)) if vals else None
 
 
 # --------------------------------------------------------------------- #

@@ -79,7 +79,8 @@ def _exposed_bytes(gather_mode: str) -> dict:
         scanned=model.scanned_param_subtrees()
         if gather_mode == "scan" else ())
     return {"exposed": rep["exposed_bytes_per_step"],
-            "hidden": rep["hidden_bytes_per_step"]}
+            "hidden": rep["hidden_bytes_per_step"],
+            "report": rep}
 
 
 def main() -> None:
@@ -101,6 +102,15 @@ def main() -> None:
     ratio = tree_rec["step_ms"] / scan_rec["step_ms"]
     exposed_reduction = (wire_tree["exposed"] / wire_scan["exposed"]
                          if wire_scan["exposed"] else float("inf"))
+    # measured-vs-analytic exposed-comm crosscheck (telemetry/perf.py):
+    # the PR 10 overlap claim as a measured, exported number — direction
+    # agreement AND the per-mode discrepancy, never asserted away
+    from ray_lightning_accelerators_tpu.telemetry import (
+        exposed_comm_crosscheck)
+    crosscheck = exposed_comm_crosscheck(
+        {"tree": tree_rec["step_ms"] / 1e3,
+         "scan": scan_rec["step_ms"] / 1e3},
+        {"tree": wire_tree["report"], "scan": wire_scan["report"]})
     record = {
         "metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
         "value": round(ratio, 3),
@@ -113,6 +123,19 @@ def main() -> None:
         "exposed_bytes_scan": wire_scan["exposed"],
         "hidden_bytes_scan": wire_scan["hidden"],
         "exposed_comm_reduction": round(exposed_reduction, 2),
+        "exposed_comm_direction_agrees": crosscheck["direction_agrees"],
+        "measured_exposed_fraction_tree": crosscheck["modes"]["tree"][
+            "measured_exposed_fraction"],
+        "measured_exposed_fraction_scan": crosscheck["modes"]["scan"][
+            "measured_exposed_fraction"],
+        "analytic_exposed_fraction_tree": crosscheck["modes"]["tree"][
+            "analytic_exposed_fraction"],
+        "analytic_exposed_fraction_scan": crosscheck["modes"]["scan"][
+            "analytic_exposed_fraction"],
+        "exposed_comm_discrepancy_tree": crosscheck["modes"]["tree"][
+            "discrepancy"],
+        "exposed_comm_discrepancy_scan": crosscheck["modes"]["scan"][
+            "discrepancy"],
         "autotune_default_step_ms": auto_rec["default_step_ms"],
         "autotune_best_step_ms": auto_rec["step_ms"],
         "autotune_speedup": auto_rec["speedup_vs_default"],

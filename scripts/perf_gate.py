@@ -1,0 +1,206 @@
+"""Bench regression gate: diff a bench window against pinned floors.
+
+The repo's perf evidence is a trail of one-JSON-line-per-metric bench
+windows (``bench.py`` stdout, archived as ``BENCH_r*.json`` driver
+records).  Nothing ever *compared* consecutive windows — a silent
+regression (or a backend dead for five rounds, BENCH_r04/r05) just
+became the new normal.  This gate makes the trajectory enforceable:
+
+- ``PERF_BASELINE.json`` pins a per-metric floor: ``baseline`` (the
+  last accepted value), ``tolerance`` (allowed fractional slack), and
+  optionally ``direction: "lower"`` for metrics where smaller is
+  better and ``field`` for records whose gated number is not ``value``.
+- ``gate_records()`` takes one window's parsed JSON records and returns
+  PASS / REGRESSION / UNGATED with a per-metric verdict table.
+- **Dead-backend windows are handled explicitly**: a window carrying a
+  ``backend_probe`` error record gates only the metrics that actually
+  landed (the CPU-mesh fallback set) and reports the accelerator
+  metrics as UNGATED.  A window with **zero** value-bearing records is
+  UNGATED as a whole and exits 2 — never silently green.
+
+Exit codes: 0 = every gated metric passed, 1 = at least one regression,
+2 = UNGATED (no gateable numbers).  ``bench.py --gate`` and format.sh
+both drive this module; stdlib-only, never imports jax (it must run on
+the machine whose backend just died).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "PERF_BASELINE.json")
+
+PASS, REGRESSION, UNGATED = "PASS", "REGRESSION", "UNGATED"
+
+
+def parse_window(text: str) -> List[Dict[str, Any]]:
+    """JSON records of one bench window.  Accepts bench.py stdout (one
+    JSON object per line amid warmup chatter) AND a driver
+    ``BENCH_r*.json`` archive (one object whose ``tail`` holds the
+    stdout) — the two shapes a gate run actually meets."""
+    text = text.strip()
+    if text.startswith("{"):
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            obj = None
+        if isinstance(obj, dict) and "tail" in obj:
+            return parse_window(obj["tail"])
+    records: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def newest_window(root: str = REPO_ROOT) -> Optional[str]:
+    """Newest committed ``BENCH_r*.json`` driver record (lexicographic =
+    chronological for the rNN naming)."""
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    return paths[-1] if paths else None
+
+
+def _is_dead_backend(records: Sequence[Mapping[str, Any]]) -> bool:
+    return any(r.get("metric") == "backend_probe" and r.get("error")
+               for r in records)
+
+
+def gate_records(records: Sequence[Mapping[str, Any]],
+                 baseline: Mapping[str, Any]) -> Dict[str, Any]:
+    """One window vs the pinned floors.  Returns ``{status, dead_backend,
+    results: [{metric, status, value, floor, ...}], regressions,
+    gated}``."""
+    default_tol = float(baseline.get("default_tolerance", 0.1))
+    specs: Mapping[str, Mapping[str, Any]] = baseline.get("metrics", {})
+    dead = _is_dead_backend(records)
+    # newest record per metric wins (a retried bench prints twice)
+    by_metric: Dict[str, Mapping[str, Any]] = {}
+    for r in records:
+        name = r.get("metric")
+        if name and "error" not in r:
+            by_metric[name] = r
+    have_numbers = any("value" in r for r in by_metric.values())
+    results: List[Dict[str, Any]] = []
+    regressions = gated = 0
+    for name, spec in sorted(specs.items()):
+        # `metric` lets two gate entries share one record (e.g. the gpt
+        # bench's tokens/sec AND its mfu field); the key stays unique
+        rec = by_metric.get(spec.get("metric", name))
+        field = spec.get("field", "value")
+        base = float(spec["baseline"])
+        tol = float(spec.get("tolerance", default_tol))
+        lower_better = spec.get("direction") == "lower"
+        bound = base * (1.0 + tol) if lower_better else base * (1.0 - tol)
+        row: Dict[str, Any] = {
+            "metric": name, "field": field, "baseline": base,
+            "tolerance": tol,
+            ("ceiling" if lower_better else "floor"): round(bound, 6),
+        }
+        value = rec.get(field) if rec is not None else None
+        if not isinstance(value, (int, float)):
+            # absent from this window: a dead-backend window legitimately
+            # lacks its accelerator metrics; either way the metric is
+            # UNGATED and listed — absence never reads as a pass
+            row["status"] = UNGATED
+            row["reason"] = ("dead-backend window" if dead
+                            else "metric absent from window")
+            results.append(row)
+            continue
+        row["value"] = value
+        gated += 1
+        ok = value <= bound if lower_better else value >= bound
+        row["status"] = PASS if ok else REGRESSION
+        if not ok:
+            regressions += 1
+        results.append(row)
+    if regressions:
+        status = REGRESSION
+    elif not have_numbers or not gated:
+        # zero value-bearing records (the BENCH_r04/r05 shape) or nothing
+        # this baseline knows how to gate: UNGATED, never silently green
+        status = UNGATED
+    else:
+        status = PASS
+    return {"status": status, "dead_backend": dead,
+            "gated": gated, "regressions": regressions,
+            "results": results}
+
+
+def _read_input(path: Optional[str]) -> tuple:
+    """(label, text) of the window to gate: an explicit file, '-' for
+    stdin, or the newest committed BENCH_r*.json."""
+    if path == "-":
+        return "<stdin>", sys.stdin.read()
+    if path:
+        with open(path) as f:
+            return path, f.read()
+    newest = newest_window()
+    if newest is None:
+        return "<none>", ""
+    with open(newest) as f:
+        return newest, f.read()
+
+
+def run(input_path: Optional[str] = None,
+        baseline_path: str = DEFAULT_BASELINE,
+        as_json: bool = False, out=None) -> int:
+    out = out if out is not None else sys.stdout  # late-bound: capturable
+    label, text = _read_input(input_path)
+    records = parse_window(text)
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf gate: cannot read baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    report = gate_records(records, baseline)
+    report["window"] = label
+    report["baseline_file"] = baseline_path
+    if as_json:
+        print(json.dumps(report, indent=1), file=out)
+    else:
+        print(f"perf gate [{report['status']}] window={label} "
+              f"gated={report['gated']} "
+              f"regressions={report['regressions']}"
+              + (" (dead-backend window: CPU-fallback metrics only)"
+                 if report["dead_backend"] else ""), file=out)
+        for row in report["results"]:
+            bound = row.get("floor", row.get("ceiling"))
+            val = row.get("value", "-")
+            print(f"  {row['status']:<10} {row['metric']:<46} "
+                  f"value={val} bound={bound}"
+                  + (f" ({row['reason']})" if "reason" in row else ""),
+                  file=out)
+    return {PASS: 0, REGRESSION: 1, UNGATED: 2}[report["status"]]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--input", default=None,
+                   help="bench window to gate: a bench.py stdout capture "
+                        "or BENCH_r*.json archive; '-' for stdin "
+                        "(default: newest committed BENCH_r*.json)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="pinned floors file (PERF_BASELINE.json)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    a = p.parse_args(argv)
+    return run(a.input, a.baseline, as_json=a.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

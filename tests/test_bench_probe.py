@@ -43,6 +43,30 @@ def test_probe_kills_hung_child_within_timeout(monkeypatch):
     assert err["probe_seconds"] < 30
 
 
+def test_probe_stall_classification_wedge_vs_dead(monkeypatch):
+    """The wedge-vs-dead triage embedded in the probe record: only a
+    child that ran out its TIMEOUT with no output reads as a wedged
+    tunnel — a fast silent death (segfault on import) and a noisy
+    timeout are both dead-backend (review finding: presence-of-output
+    alone misdiagnosed fast crashes as hangs)."""
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "import time; time.sleep(600)")
+    err = bench.probe_backend(timeout_s=2)
+    assert err["stall"]["classification"] == "wedged-tunnel"
+    # fast silent exit: dead backend, NOT a wedge (no timeout occurred)
+    monkeypatch.setattr(bench, "_PROBE_SRC", "raise SystemExit(1)")
+    err = bench.probe_backend(timeout_s=30)
+    assert err["stall"]["classification"] == "dead-backend"
+    assert err["probe_seconds"] < 30
+    # noisy timeout: the backend answered, then died
+    assert bench._flight_diagnosis("partial output", "",
+                                   timed_out=True)["stall"][
+        "classification"] == "dead-backend"
+    # spill tails ride along when a telemetry dir holds rank files
+    tails = bench._flight_diagnosis("", "", timed_out=True)
+    assert "flight_tail" not in tails  # no dir configured -> absent
+
+
 def test_probe_rejects_child_without_marker(monkeypatch):
     # a child that exits 0 but never ran the device op must NOT pass
     monkeypatch.setattr(bench, "_PROBE_SRC", "print('something else')")
@@ -54,7 +78,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     """main() with a dead backend: the death record comes FIRST, no
     accelerator bench ever ran -- and the CPU-mesh fallback benches
     (gradexchange/input_pipeline/fsdp_exchange/paged_serve/
-    mfu_overlap)
+    mfu_overlap/perf_observatory)
     still land REAL metric lines next
     to the death record, so the window exits 0 and the driver records
     numbers (all five earlier BENCH rounds were rc=2 with zero real
@@ -90,13 +114,17 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_mfu_overlap",
         lambda: {"metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
                  "value": 1.3, "unit": "x", "vs_baseline": 1.3})
+    monkeypatch.setattr(
+        bench, "bench_perf_observatory",
+        lambda: {"metric": "perf_observatory_phase_coverage",
+                 "value": 0.97, "unit": "fraction", "vs_baseline": 1.13})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 6
+    assert len(lines) == 7
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
@@ -104,6 +132,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert lines[3]["metric"] == "fsdp_exchange_int8_wire_bytes_reduction"
     assert lines[4]["metric"] == "paged_serve_concurrency_per_hbm_ratio"
     assert lines[5]["metric"] == "mfu_overlap_scan_vs_tree_step_time_ratio"
+    assert lines[6]["metric"] == "perf_observatory_phase_coverage"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -118,7 +147,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         "backend_probe", "input_pipeline_prefetch_speedup",
         "fsdp_exchange_int8_wire_bytes_reduction",
         "paged_serve_concurrency_per_hbm_ratio",
-        "mfu_overlap_scan_vs_tree_step_time_ratio"]
+        "mfu_overlap_scan_vs_tree_step_time_ratio",
+        "perf_observatory_phase_coverage"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -129,6 +159,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_paged_serve",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_mfu_overlap",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_perf_observatory",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -173,6 +205,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_mfu_overlap",
         lambda: {"metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
                  "value": 1.3, "unit": "x", "vs_baseline": 1.3})
+    monkeypatch.setattr(
+        bench, "bench_perf_observatory",
+        lambda: {"metric": "perf_observatory_phase_coverage",
+                 "value": 0.97, "unit": "fraction", "vs_baseline": 1.13})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -187,7 +223,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "input_pipeline_prefetch_speedup",
         "fsdp_exchange_int8_wire_bytes_reduction",
         "paged_serve_concurrency_per_hbm_ratio",
-        "mfu_overlap_scan_vs_tree_step_time_ratio"]
+        "mfu_overlap_scan_vs_tree_step_time_ratio",
+        "perf_observatory_phase_coverage"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -292,6 +329,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_mfu_overlap",
         lambda: {"metric": "mfu_overlap_scan_vs_tree_step_time_ratio",
                  "value": 1.3, "unit": "x", "vs_baseline": 1.3})
+    monkeypatch.setattr(
+        bench, "bench_perf_observatory",
+        lambda: {"metric": "perf_observatory_phase_coverage",
+                 "value": 0.97, "unit": "fraction", "vs_baseline": 1.13})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -306,6 +347,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "fsdp_exchange_int8_wire_bytes_reduction" in metrics
     assert "paged_serve_concurrency_per_hbm_ratio" in metrics
     assert "mfu_overlap_scan_vs_tree_step_time_ratio" in metrics
+    assert "perf_observatory_phase_coverage" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

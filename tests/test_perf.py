@@ -1,0 +1,528 @@
+"""Perf observatory (telemetry/perf.py) + bench regression gate
+(scripts/perf_gate.py): phase-timeline accounting, HBM pool attribution
+and leak alarm, goodput partition, the trainer integration's zero-
+retrace and bounded-overhead guarantees, and the gate's
+regression/wobble/dead-window verdicts."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.telemetry import (GoodputLedger,
+                                                      HbmLedger,
+                                                      MetricsRegistry,
+                                                      PerfObservatory,
+                                                      StepTimeline,
+                                                      exposed_comm_crosscheck)
+from ray_lightning_accelerators_tpu.telemetry import recorder as R
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+import perf_gate  # noqa: E402  (scripts/ is not a package)
+
+pytestmark = pytest.mark.perf
+
+
+# --------------------------------------------------------------------- #
+# StepTimeline                                                           #
+# --------------------------------------------------------------------- #
+def test_timeline_phases_sum_to_step_wall():
+    tl = StepTimeline(ring=8)
+    for _ in range(5):
+        tl.step_begin()
+        with tl.phase("h2d"):
+            time.sleep(0.001)
+        with tl.phase("compute"):
+            time.sleep(0.004)
+        tl.step_end()
+    snap = tl.snapshot()
+    assert snap["steps"] == 5
+    # in-step phases sum to wall by construction (`other` absorbs the
+    # remainder) and the hooks cover nearly all of it here
+    assert snap["phase_sum_over_wall"] == pytest.approx(1.0, abs=1e-6)
+    assert snap["attributed_fraction"] > 0.9
+    assert snap["phases"]["compute"]["total_s"] > \
+        snap["phases"]["h2d"]["total_s"]
+
+
+def test_timeline_ring_bounded_and_out_of_step_phases():
+    tl = StepTimeline(ring=4)
+    for _ in range(10):
+        tl.step_begin()
+        tl.step_end()
+    with tl.phase("ckpt"):
+        time.sleep(0.001)
+    snap = tl.snapshot()
+    assert len(snap["recent_steps"]) == 4
+    assert snap["recent_steps"][-1]["step"] == 10
+    assert snap["between_step_phases"]["ckpt"]["count"] == 1
+    assert "ckpt" not in snap["phases"]  # outside any step bracket
+
+
+def test_timeline_compile_split_out_of_containing_phase():
+    clock = {"s": 0.0}
+    tl = StepTimeline(ring=4, compile_seconds_fn=lambda: clock["s"])
+    tl.step_begin()
+    with tl.phase("compute"):
+        clock["s"] += 0.5  # a "compile" lands inside the dispatch
+        time.sleep(0.002)
+    tl.step_end()
+    snap = tl.snapshot()
+    # compile is its own phase, clamped to the containing measured
+    # phase, and the sum-to-wall invariant survives the split
+    assert "compile" in snap["phases"]
+    assert snap["phases"]["compile"]["total_s"] <= \
+        snap["step_wall_total_s"] + 1e-9
+    # abs=1e-3: the snapshot rounds phase totals to 1us, which on a
+    # 2ms step is a ~1e-3 relative quantization
+    assert snap["phase_sum_over_wall"] == pytest.approx(1.0, abs=1e-3)
+
+
+def test_timeline_scan_epoch_rows():
+    tl = StepTimeline(ring=4)
+    tl.observe_scan_epoch(0.8, 16)
+    snap = tl.snapshot()
+    assert snap["steps"] == 16
+    assert snap["phases"]["compute"]["count"] == 16
+    assert snap["recent_steps"][-1]["scanned_steps"] == 16
+
+
+def test_timeline_overhead_bounded():
+    """The recorder's <50us/emit spirit for the sampling seams: a full
+    step bracket with two phases (6 perf_counter reads + dict ops) must
+    stay far under the budget, or the observatory is not attachable to
+    a hot loop."""
+    tl = StepTimeline(ring=64)
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tl.step_begin()
+        with tl.phase("h2d"):
+            pass
+        with tl.phase("compute"):
+            pass
+        tl.step_end()
+    per_step = (time.perf_counter() - t0) / n
+    assert per_step < 50e-6, f"{per_step * 1e6:.1f}us per step bracket"
+
+
+# --------------------------------------------------------------------- #
+# HbmLedger                                                              #
+# --------------------------------------------------------------------- #
+def test_hbm_pools_attribute_against_total():
+    state = {"params": 1000, "opt": 2000}
+    led = HbmLedger(sample_min_s=0.0,
+                    total_bytes_fn=lambda: sum(state.values()) + 300)
+    led.register_pool("params", lambda: state["params"])
+    led.register_pool("opt", lambda: state["opt"])
+    out = led.sample()
+    assert out["params"] == 1000 and out["opt"] == 2000
+    assert out["other"] == 300 and out["total"] == 3300
+    snap = led.snapshot()
+    # pools + other == total exactly; attributed excludes `other`
+    assert snap["attributed_bytes"] + snap["pools"]["other"]["bytes"] \
+        == snap["total_bytes"]
+    assert snap["attributed_fraction"] == pytest.approx(3000 / 3300,
+                                                        abs=1e-3)
+    # watermarks survive a shrink
+    state["opt"] = 100
+    led.sample()
+    assert led.snapshot()["pools"]["opt"]["peak_bytes"] == 2000
+
+
+def test_hbm_throttle_and_dead_reader():
+    led = HbmLedger(sample_min_s=3600.0, total_bytes_fn=lambda: 10)
+    led.register_pool("boom", lambda: (_ for _ in ()).throw(
+        RuntimeError("dead")))
+    assert led.sample()["boom"] == 0  # dead reader reports 0, no crash
+    assert led.maybe_sample() is None  # inside the throttle window
+    assert led.snapshot()["samples"] == 1
+
+
+def test_hbm_leak_alarm_fires_once_per_streak():
+    R.configure()  # fresh ring
+    state = {"b": 1000}
+    led = HbmLedger(sample_min_s=0.0, leak_samples=3, leak_min_bytes=500,
+                    total_bytes_fn=lambda: state["b"])
+    led.register_pool("pool", lambda: state["b"])
+    for _ in range(6):  # strictly growing, past both thresholds
+        led.sample()
+        state["b"] += 300
+    events = [e for e in R.get_recorder().events()
+              if e["kind"] == "hbm_leak"]
+    assert len(events) == 1  # one alarm per streak, not one per sample
+    assert events[0]["data"]["suspect_pool"] == "pool"
+    assert events[0]["data"]["growth_bytes"] >= 500
+    # growth stops (below the LAST SAMPLE, not just the start) -> the
+    # alarm re-arms -> a NEW streak fires again
+    state["b"] -= 500
+    led.sample()
+    for _ in range(5):
+        state["b"] += 400
+        led.sample()
+    events = [e for e in R.get_recorder().events()
+              if e["kind"] == "hbm_leak"]
+    assert len(events) == 2
+    assert led.snapshot()["leak_alarms"] == 2
+
+
+def test_hbm_below_thresholds_never_alarms():
+    R.configure()
+    state = {"b": 1000}
+    led = HbmLedger(sample_min_s=0.0, leak_samples=5,
+                    leak_min_bytes=10 ** 9,
+                    total_bytes_fn=lambda: state["b"])
+    for _ in range(20):
+        led.sample()
+        state["b"] += 1  # grows, but far under leak_min_bytes
+    assert not [e for e in R.get_recorder().events()
+                if e["kind"] == "hbm_leak"]
+
+
+# --------------------------------------------------------------------- #
+# GoodputLedger                                                          #
+# --------------------------------------------------------------------- #
+def test_goodput_partition_and_fraction():
+    gl = GoodputLedger()
+    gl.run_begin()
+    with gl.measure("restart"):
+        time.sleep(0.01)
+    gl.account("productive", 0.03)
+    gl.account("drain", 0.005)
+    time.sleep(0.03)
+    gl.run_end()
+    snap = gl.snapshot()
+    assert snap["wall_s"] >= 0.04
+    assert set(snap["seconds"]) == {"restart", "productive", "drain"}
+    assert 0.0 < snap["goodput_fraction"] <= 1.0
+    assert snap["unattributed_s"] >= 0.0
+
+
+def test_timeline_foreign_thread_observe_stays_out_of_open_step():
+    """A serve loop sharing the timeline with a fitting trainer must
+    not write into the trainer's open step bracket (review finding:
+    the in-step branch keyed on _t_step alone, any thread)."""
+    import threading
+    tl = StepTimeline(ring=4)
+    tl.step_begin()
+    t = threading.Thread(target=lambda: tl.observe("decode", 0.5))
+    t.start()
+    t.join()
+    with tl.phase("compute"):
+        time.sleep(0.001)
+    tl.step_end()
+    snap = tl.snapshot()
+    assert "decode" not in snap["phases"]  # foreign thread excluded
+    assert snap["between_step_phases"]["decode"]["total_s"] == \
+        pytest.approx(0.5)
+    assert snap["phase_sum_over_wall"] == pytest.approx(1.0, abs=1e-3)
+
+
+def test_goodput_rerun_resets_the_ledger():
+    """A reused ElasticRunner's second run() must not compute wall from
+    the first run's start (review finding: first-call-wins run_begin
+    diluted the fraction with inter-run idle)."""
+    gl = GoodputLedger()
+    gl.run_begin()
+    gl.note_attempt()
+    gl.account("productive", 5.0)
+    gl.run_end()
+    time.sleep(0.02)  # inter-run idle that must NOT count
+    gl.run_begin()
+    gl.account("productive", 0.01)
+    time.sleep(0.01)
+    gl.run_end()
+    snap = gl.snapshot()
+    assert snap["wall_s"] < 0.02  # second run only
+    assert snap["attempts"] == 0 and snap["seconds"]["productive"] \
+        == pytest.approx(0.01)
+    # run_begin while a run is OPEN stays a no-op
+    gl2 = GoodputLedger()
+    gl2.run_begin()
+    time.sleep(0.01)
+    gl2.run_begin()
+    gl2.run_end()
+    assert gl2.snapshot()["wall_s"] >= 0.01
+
+
+def test_goodput_absorbs_timeline_and_events():
+    gl = GoodputLedger()
+    gl.run_begin()
+    gl.absorb_timeline({
+        "phases": {"compute": {"total_s": 2.0}, "h2d": {"total_s": 0.5},
+                   "compile": {"total_s": 1.0}},
+        "between_step_phases": {"ckpt": {"total_s": 0.25}}})
+    gl.absorb_events([
+        {"kind": "preempt_drain", "ts": 10.0},
+        {"kind": "emergency_checkpoint", "ts": 10.4}])
+    gl.run_end()
+    s = gl.snapshot()["seconds"]
+    assert s["productive"] == pytest.approx(2.5)
+    assert s["compile"] == pytest.approx(1.0)
+    assert s["checkpoint"] == pytest.approx(0.25)
+    assert s["drain"] == pytest.approx(0.4)
+
+
+# --------------------------------------------------------------------- #
+# Exposed-comm crosscheck                                                #
+# --------------------------------------------------------------------- #
+def test_exposed_comm_crosscheck_direction_and_discrepancy():
+    cc = exposed_comm_crosscheck(
+        {"tree": 0.40, "scan": 0.30},
+        {"tree": {"exchange_bytes_per_step": 100,
+                  "exposed_bytes_per_step": 100},
+         "scan": {"exchange_bytes_per_step": 100,
+                  "exposed_bytes_per_step": 10}})
+    assert cc["direction_agrees"]
+    assert cc["measured_order"] == ["scan", "tree"]
+    t = cc["modes"]["tree"]
+    assert t["measured_exposed_fraction"] == pytest.approx(0.25)
+    assert t["analytic_exposed_fraction"] == 1.0
+    assert t["discrepancy"] == pytest.approx(-0.75)
+    assert cc["modes"]["scan"]["measured_exposed_fraction"] == 0.0
+    # a disagreement is EXPORTED, not asserted away
+    cc2 = exposed_comm_crosscheck(
+        {"tree": 0.30, "scan": 0.40},
+        {"tree": {"exchange_bytes_per_step": 100,
+                  "exposed_bytes_per_step": 100},
+         "scan": {"exchange_bytes_per_step": 100,
+                  "exposed_bytes_per_step": 10}})
+    assert not cc2["direction_agrees"]
+    with pytest.raises(ValueError, match=">= 2 modes"):
+        exposed_comm_crosscheck({"tree": 0.1}, {"tree": {}})
+
+
+# --------------------------------------------------------------------- #
+# Registry export                                                        #
+# --------------------------------------------------------------------- #
+def test_registry_exports_all_three_ledgers():
+    tl = StepTimeline(ring=4)
+    tl.step_begin()
+    with tl.phase("compute"):
+        pass
+    tl.step_end()
+    led = HbmLedger(sample_min_s=0.0, total_bytes_fn=lambda: 100)
+    led.register_pool("params", lambda: 80)
+    led.sample()
+    gl = GoodputLedger()
+    gl.run_begin()
+    gl.account("productive", 0.5)
+    gl.run_end()
+    reg = MetricsRegistry()
+    reg.add_step_timeline(tl)
+    reg.add_hbm(led)
+    reg.add_goodput(gl)
+    j = reg.to_json()
+    assert set(j["perf"]) == {"step_timeline", "hbm", "goodput"}
+    txt = reg.prometheus_text()
+    for needle in ("rla_tpu_steps_total",
+                   'rla_tpu_step_phase_seconds_total{phase="compute"}',
+                   'rla_tpu_hbm_pool_bytes{pool="params"}',
+                   "rla_tpu_hbm_attributed_fraction",
+                   'rla_tpu_goodput_seconds_total{category="productive"}',
+                   "rla_tpu_goodput_fraction"):
+        assert needle in txt, needle
+
+
+# --------------------------------------------------------------------- #
+# Trainer integration                                                    #
+# --------------------------------------------------------------------- #
+def _mnist_fit(tmpdir, perf, **kw):
+    from ray_lightning_accelerators_tpu import (DataLoader,
+                                                RayTPUAccelerator,
+                                                Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.models.mnist import (
+        MNISTClassifier, synthetic_mnist)
+    x, y = synthetic_mnist(256, seed=0)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=64, shuffle=False)
+    model = MNISTClassifier({"layer_1": 32, "layer_2": 32, "lr": 1e-3,
+                             "batch_size": 64})
+    trainer = Trainer(max_epochs=3, precision="f32", seed=0,
+                      accelerator=RayTPUAccelerator(),
+                      enable_checkpointing=True,
+                      log_every_n_steps=10 ** 9,
+                      perf_observatory=perf,
+                      default_root_dir=str(tmpdir), **kw)
+    trainer.fit(model, loader)
+    return trainer
+
+
+def test_trainer_observatory_timeline_hbm_and_report(tmp_path):
+    perf = PerfObservatory(hbm=HbmLedger(sample_min_s=0.0))
+    trainer = _mnist_fit(tmp_path, perf)
+    tl = perf.timeline.snapshot()
+    assert tl["steps"] == trainer.global_step == 12
+    assert tl["phase_sum_over_wall"] == pytest.approx(1.0, abs=1e-6)
+    # the acceptance bar: named phases cover >= 90% of step wall
+    assert tl["attributed_fraction"] >= 0.9, tl["phases"]
+    assert "compute" in tl["phases"]
+    assert tl["between_step_phases"]["ckpt"]["total_s"] > 0  # saves
+    hbm = perf.hbm.snapshot()
+    assert hbm["pools"]["params"]["bytes"] > 0
+    assert hbm["pools"]["opt_state"]["bytes"] > 0
+    # pools + other == the live placed-array total, exactly
+    assert hbm["attributed_bytes"] + hbm["pools"]["other"]["bytes"] \
+        == hbm["total_bytes"]
+    reg = trainer.build_metrics_registry()
+    j = reg.to_json()
+    assert "step_timeline" in j["perf"] and "hbm" in j["perf"]
+
+
+@pytest.mark.analysis
+def test_trainer_zero_retraces_with_observatory(tmp_path):
+    """The observatory must be attachable to the hot loop for free: the
+    12-step fit compiles its programs once and retraces ZERO times in
+    steady state with the timeline + HBM sampler live (same contract
+    the PR 6 compile-guard test pins for the bare trainer)."""
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
+    from ray_lightning_accelerators_tpu.core.callbacks import Callback
+
+    counts = []
+
+    class Snap(Callback):
+        def on_train_batch_end(self, trainer, module, metrics, idx):
+            counts.append(cg.compile_count())
+
+    perf = PerfObservatory(hbm=HbmLedger(sample_min_s=0.0))
+    _mnist_fit(tmp_path, perf, callbacks=[Snap()])
+    assert len(counts) == 12
+    # steady state: after the warmup step every later step compiles 0
+    assert counts[-1] == counts[1], (
+        f"retrace with observatory enabled: {counts}")
+
+
+# --------------------------------------------------------------------- #
+# Perf gate                                                              #
+# --------------------------------------------------------------------- #
+_BASE = {"default_tolerance": 0.1,
+         "metrics": {
+             "m_up": {"baseline": 100.0, "tolerance": 0.1},
+             "m_down": {"baseline": 10.0, "tolerance": 0.2,
+                        "direction": "lower"},
+             "m_field": {"metric": "m_up", "field": "aux",
+                         "baseline": 0.5, "tolerance": 0.1}}}
+
+
+def _recs(up=100.0, down=10.0, aux=0.5):
+    return [{"metric": "m_up", "value": up, "aux": aux},
+            {"metric": "m_down", "value": down}]
+
+
+def test_gate_passes_within_tolerance_wobble():
+    rep = perf_gate.gate_records(_recs(up=92.0, down=11.5, aux=0.47),
+                                 _BASE)
+    assert rep["status"] == "PASS"
+    assert rep["regressions"] == 0 and rep["gated"] == 3
+
+
+def test_gate_fails_injected_regression():
+    rep = perf_gate.gate_records(_recs(up=85.0), _BASE)  # < 90 floor
+    assert rep["status"] == "REGRESSION"
+    bad = [r for r in rep["results"] if r["status"] == "REGRESSION"]
+    assert [r["metric"] for r in bad] == ["m_up"]
+    # direction=lower regresses UPWARD
+    rep2 = perf_gate.gate_records(_recs(down=13.0), _BASE)  # > 12 ceiling
+    assert rep2["status"] == "REGRESSION"
+    # a regression in a non-`value` field is caught too
+    rep3 = perf_gate.gate_records(_recs(aux=0.3), _BASE)
+    assert rep3["status"] == "REGRESSION"
+
+
+def test_gate_dead_backend_window_gates_fallbacks_only():
+    records = [{"metric": "backend_probe", "value": 0,
+                "error": "backend unavailable"},
+               {"metric": "m_up", "value": 98.0, "aux": 0.5}]
+    rep = perf_gate.gate_records(records, _BASE)
+    assert rep["dead_backend"]
+    assert rep["status"] == "PASS"  # the fallback metric gated and passed
+    by = {r["metric"]: r for r in rep["results"]}
+    assert by["m_down"]["status"] == "UNGATED"
+    assert by["m_down"]["reason"] == "dead-backend window"
+
+
+def test_gate_zero_numbers_window_is_ungated_never_green():
+    records = [{"metric": "backend_probe", "value": 0,
+                "error": "backend unavailable",
+                "detail": "device probe hung > 120s"}]
+    rep = perf_gate.gate_records(records, _BASE)
+    assert rep["status"] == "UNGATED"
+    assert all(r["status"] == "UNGATED" for r in rep["results"])
+    # and the CLI maps it to rc 2 (never 0)
+    assert perf_gate.run.__defaults__ is not None  # sanity
+
+
+def test_gate_cli_roundtrip(tmp_path, capsys):
+    window = tmp_path / "window.jsonl"
+    window.write_text("\n".join(json.dumps(r) for r in _recs()))
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_BASE))
+    rc = perf_gate.main(["--input", str(window),
+                         "--baseline", str(base)])
+    assert rc == 0
+    assert "perf gate [PASS]" in capsys.readouterr().out
+    window.write_text("\n".join(json.dumps(r)
+                                for r in _recs(up=50.0)))
+    assert perf_gate.main(["--input", str(window),
+                           "--baseline", str(base)]) == 1
+    # a BENCH_r*.json driver archive (records inside `tail`) parses too
+    arch = tmp_path / "BENCH_r99.json"
+    arch.write_text(json.dumps(
+        {"rc": 0, "tail": "\n".join(json.dumps(r) for r in _recs())}))
+    assert perf_gate.main(["--input", str(arch),
+                           "--baseline", str(base)]) == 0
+
+
+def test_gate_parse_window_skips_chatter():
+    text = ("WARNING: some log line\n"
+            + json.dumps({"metric": "m_up", "value": 1}) + "\n"
+            + "not json {\n")
+    recs = perf_gate.parse_window(text)
+    assert recs == [{"metric": "m_up", "value": 1}]
+
+
+# --------------------------------------------------------------------- #
+# Elastic goodput integration (stub pool, no processes)                  #
+# --------------------------------------------------------------------- #
+def test_elastic_runner_owns_a_goodput_ledger():
+    from ray_lightning_accelerators_tpu.runtime.elastic import \
+        ElasticRunner
+
+    class _F:
+        def __init__(self, v):
+            self._v = v
+
+        def done(self):
+            return True
+
+        def exception(self):
+            return None
+
+        def result(self, timeout=None):
+            return self._v
+
+    class _StubPool:
+        def __init__(self):
+            self.workers = []
+
+        def __len__(self):
+            return 1
+
+        def execute_all(self, fn):
+            return [_F(fn())]
+
+    runner = ElasticRunner(_StubPool(), max_failures=0)
+    out = runner.run(lambda: 7)
+    assert out == [7]
+    snap = runner.goodput.snapshot()
+    assert snap["attempts"] == 1 and snap["preemptions"] == 0
+    assert snap["wall_s"] > 0.0
+    # deterministic regardless of the stub run's (sub-millisecond,
+    # 1us-quantized) wall: over-accounting clamps the fraction to 1.0
+    runner.goodput.account("productive", snap["wall_s"] + 1.0)
+    assert runner.goodput.snapshot()["goodput_fraction"] == 1.0

@@ -64,10 +64,15 @@ def test_continuous_batching_token_identical_to_generate():
     assert snap["steps_batch_gt1"] >= 1, snap
     assert snap["max_batch"] >= 2
     assert all(r.ttft_s is not None and r.ttft_s >= 0 for r in resps)
-    # tail-latency fields are present through p99/max
-    for fam in ("ttft_s", "token_latency_s", "decode_step_s"):
+    # tail-latency fields are present through p99/max; queue_wait splits
+    # queueing from prefill (one observation per admitted request, and
+    # the wait can never exceed the ttft that contains it)
+    for fam in ("ttft_s", "queue_wait_s", "token_latency_s",
+                "decode_step_s"):
         for k in ("p50_s", "p95_s", "p99_s", "max_s"):
             assert k in snap[fam]
+    assert snap["queue_wait_s"]["count"] == 8
+    assert snap["queue_wait_s"]["max_s"] <= snap["ttft_s"]["max_s"]
 
 
 def test_single_token_budget_completes_at_prefill():

@@ -221,20 +221,22 @@ def test_serve_metrics_reset_clears_every_structure():
     m = ServeMetrics()
     m.inc("submitted")
     m.observe_ttft(0.1)
+    m.observe_queue_wait(0.04)
     m.observe_prefill(0.05)
     m.observe_step(0.01, active=3)
     m.observe_token_latency(0.002)
     before = m.snapshot()
     assert before["submitted"] == 1 and before["max_batch"] == 3 \
-        and before["busy_s"] > 0 and before["ttft_s"] is not None
+        and before["busy_s"] > 0 and before["ttft_s"] is not None \
+        and before["queue_wait_s"] is not None
     m.reset()
     snap = m.snapshot()
     for k in ServeMetrics._COUNTERS:
         assert snap[k] == 0, f"reset missed counter {k!r}"
     assert snap["max_batch"] == 0
     assert snap["busy_s"] == 0.0 and snap["throughput_tok_s"] == 0.0
-    for fam in ("ttft_s", "token_latency_s", "decode_step_s",
-                "prefill_s"):
+    for fam in ("ttft_s", "queue_wait_s", "token_latency_s",
+                "decode_step_s", "prefill_s"):
         assert snap[fam] is None, f"reset missed reservoir {fam!r}"
     assert m.profiler.summary() == {}
 
