@@ -35,9 +35,18 @@ else
 fi
 
 # graftlint: the JAX-aware invariant checks (host syncs in hot paths,
-# retrace hazards, knob/wire registry drift) — exits nonzero on findings
+# retrace hazards, knob/wire registry drift, SPMD collective/rank-
+# divergence safety) — exits nonzero on findings
 python scripts/graftlint.py ray_lightning_accelerators_tpu
 echo "format.sh: graftlint clean"
+
+# sharding audit: regenerate SHARDING_INVENTORY.json (the ShardingPlan
+# reconnaissance artifact).  Drift (a PartitionSpec literal outside the
+# inventoried modules) already failed the graftlint step above as an
+# active `sharding-inventory` finding, so the audit skips its own lint
+# pass here — extraction only, one lint per format.sh run.
+python scripts/sharding_audit.py --out SHARDING_INVENTORY.json --skip-drift
+echo "format.sh: sharding inventory refreshed (drift gated by graftlint above)"
 
 # perf gate: the newest bench window vs PERF_BASELINE.json floors
 # (scripts/perf_gate.py).  rc 1 = a gated metric regressed -> fail here,

@@ -7,7 +7,12 @@ Two halves (see docs/API.md "Static analysis & compile guard"):
   in the hot step/decode paths, no retrace hazards at jit boundaries, no
   tracer leakage out of jitted functions, every ``RLA_TPU_*`` env knob
   declared in the `knobs` registry, every worker-raised typed exception
-  wire-rebuildable (`runtime/wire.py`).  CLI: ``scripts/graftlint.py``.
+  wire-rebuildable (`runtime/wire.py`) — plus the SPMD safety pass:
+  collective axis arguments resolve to declared mesh axes, no
+  rank-divergent control flow around collectives/barriers/commits, no
+  PartitionSpec literals off the audited sharding surface
+  (``scripts/sharding_audit.py``).  CLI: ``scripts/graftlint.py``
+  (``--format json`` for CI / the audit script).
 - **compile-guard** (`compile_guard.py`): a runtime complement counting
   XLA backend compiles via ``jax.monitoring``, so a test (or bench) can
   assert "this block compiles at most N programs" — the serve engine's

@@ -147,6 +147,14 @@ _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
 _register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
                "preemption grace budget in seconds; setting it installs "
                "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_SPMD_SANITIZER", "bool", False,
+               "opt-in cross-rank collective sanitizer: each process "
+               "records its traced collective call sequence and the "
+               "driver diffs sequences across ranks after fan-out/chaos "
+               "runs (testing/spmd_sanitizer.py)"))
+_register(Knob("RLA_TPU_SPMD_SEQ_EVENTS", "int", 512,
+               "sanitizer sequence-ring capacity in recorded collective "
+               "calls per process (testing/spmd_sanitizer.py)"))
 _register(Knob("RLA_TPU_TELEMETRY", "bool", True,
                "enable the flight recorder; 0 makes every emit a no-op "
                "(telemetry/recorder.py)"))

@@ -79,6 +79,9 @@ class ModuleInfo:
     pragmas: Dict[int, Set[str]]
     pragma_missing_reason: List[int]
     consts: Dict[str, str] = field(default_factory=dict)
+    # module-level tuples of strings (AXIS_ORDER, BATCH_AXES): name ->
+    # resolved string elements, for axis-name-set resolution
+    tuple_consts: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     # alias -> module key ("import x.y as z" / "from ..r import m as z")
     mod_aliases: Dict[str, str] = field(default_factory=dict)
     # local name -> (module key, original name) for "from m import NAME"
@@ -116,22 +119,44 @@ def parse_module(key: str, source: str) -> ModuleInfo:
                       pragma_missing_reason=missing)
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Constant) \
-                and isinstance(node.value.value, str):
-            info.consts[node.targets[0].id] = node.value.value
-        elif isinstance(node, ast.ImportFrom):
-            target = _resolve_import(key, node.module, node.level)
-            for alias in node.names:
-                local = alias.asname or alias.name
-                if target is None:
-                    continue
-                # "from ..runtime import preemption as preempt_lib":
-                # the imported NAME may itself be a module of the tree
-                submodule = target[:-3] + "/" + alias.name + ".py" \
-                    if target.endswith(".py") else None
-                info.mod_aliases[local] = submodule or target
-                info.imported_names[local] = (target, alias.name)
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                info.consts[name] = node.value.value
+            elif isinstance(node.value, (ast.Tuple, ast.List)):
+                # tuple-of-strings constants (AXIS_ORDER, BATCH_AXES):
+                # elements are literals or earlier same-module consts
+                vals: List[str] = []
+                for e in node.value.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        vals.append(e.value)
+                    elif isinstance(e, ast.Name) and e.id in info.consts:
+                        vals.append(info.consts[e.id])
+                    else:
+                        vals = []
+                        break
+                if vals:
+                    info.tuple_consts[name] = tuple(vals)
+    # imports are collected over the WHOLE tree (not just module level):
+    # hot paths routinely do function-local relative imports
+    # ("from ..parallel import mesh as mesh_lib" inside a builder) and
+    # constant/axis resolution must see those aliases too
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        target = _resolve_import(key, node.module, node.level)
+        for alias in node.names:
+            local = alias.asname or alias.name
+            if target is None:
+                continue
+            # "from ..runtime import preemption as preempt_lib":
+            # the imported NAME may itself be a module of the tree
+            submodule = target[:-3] + "/" + alias.name + ".py" \
+                if target.endswith(".py") else None
+            info.mod_aliases.setdefault(local, submodule or target)
+            info.imported_names.setdefault(local, (target, alias.name))
     return info
 
 
@@ -175,6 +200,42 @@ def resolve_str(ctx: "LintContext", module: ModuleInfo,
             target = ctx.modules.get(modkey)
             if target is not None:
                 return target.consts.get(node.attr)
+    return None
+
+
+def resolve_str_tuple(ctx: "LintContext", module: ModuleInfo,
+                      node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """A tuple of strings the expression statically evaluates to: a
+    string resolves to a 1-tuple, a tuple/list literal element-wise, a
+    name to a registered tuple constant (``BATCH_AXES``) — including
+    through import aliases (``mesh_lib.BATCH_AXES``) and ``from m
+    import NAME``.  None when any part is not statically resolvable."""
+    s = resolve_str(ctx, module, node)
+    if s is not None:
+        return (s,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            sub = resolve_str_tuple(ctx, module, e)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return tuple(out)
+    if isinstance(node, ast.Name):
+        if node.id in module.tuple_consts:
+            return module.tuple_consts[node.id]
+        imp = module.imported_names.get(node.id)
+        if imp is not None:
+            target = ctx.modules.get(imp[0])
+            if target is not None and imp[1] in target.tuple_consts:
+                return target.tuple_consts[imp[1]]
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        modkey = module.mod_aliases.get(node.value.id)
+        if modkey is not None:
+            target = ctx.modules.get(modkey)
+            if target is not None:
+                return target.tuple_consts.get(node.attr)
     return None
 
 
@@ -385,7 +446,19 @@ DEFAULT_WORKER_MODULES: Tuple[str, ...] = (
     "runtime/actors.py", "runtime/bootstrap.py", "runtime/elastic.py",
     "runtime/object_store.py", "runtime/preemption.py", "runtime/queue.py",
     "runtime/session.py", "runtime/watchdog.py", "core/trainer.py",
-    "testing/chaos.py",
+    "testing/chaos.py", "testing/spmd_sanitizer.py",
+)
+
+
+# modules that legitimately DECLARE PartitionSpec layouts — the surface
+# scripts/sharding_audit.py inventories and ROADMAP item 5's ShardingPlan
+# refactor will consolidate.  A PartitionSpec literal anywhere else is a
+# `sharding-inventory` finding (new sharding logic growing outside the
+# governed seam), suppressible with a reasoned pragma.
+DEFAULT_INVENTORY_MODULES: Tuple[str, ...] = (
+    "parallel/mesh.py", "parallel/sharding.py", "parallel/collectives.py",
+    "parallel/ulysses.py", "parallel/ring_attention.py",
+    "parallel/pipeline.py", "core/trainer.py", "accelerators/base.py",
 )
 
 
@@ -393,18 +466,25 @@ DEFAULT_WORKER_MODULES: Tuple[str, ...] = (
 class LintConfig:
     knob_names: frozenset = frozenset()
     wire_names: frozenset = frozenset()
+    # declared mesh axis names (extracted from `axes_module`): the only
+    # names a collective's axis argument may resolve to
+    spmd_axis_names: frozenset = frozenset()
     hot_roots: Mapping[str, Tuple[str, ...]] = field(
         default_factory=lambda: dict(DEFAULT_HOT_ROOTS))
     worker_modules: Tuple[str, ...] = DEFAULT_WORKER_MODULES
+    inventory_modules: Tuple[str, ...] = DEFAULT_INVENTORY_MODULES
     # file (module key) the knob registry lives in: exempt from the
     # raw-environ rule (it IS the sanctioned reader)
     knobs_module: str = "analysis/knobs.py"
     wire_module: str = "runtime/wire.py"
+    # file declaring the canonical mesh axis constants (DATA_AXIS ...
+    # EXPERT_AXIS, AXIS_ORDER, BATCH_AXES)
+    axes_module: str = "parallel/mesh.py"
 
     @classmethod
     def for_tree(cls, files: Mapping[str, str]) -> "LintConfig":
-        """Config with knob/wire registries extracted statically from the
-        tree being linted (no package import needed)."""
+        """Config with knob/wire/axis registries extracted statically
+        from the tree being linted (no package import needed)."""
         cfg = cls()
         knobs_src = files.get(cfg.knobs_module)
         if knobs_src is not None:
@@ -412,6 +492,10 @@ class LintConfig:
         wire_src = files.get(cfg.wire_module)
         if wire_src is not None:
             cfg = replace(cfg, wire_names=_wire_names_from_source(wire_src))
+        axes_src = files.get(cfg.axes_module)
+        if axes_src is not None:
+            cfg = replace(cfg,
+                          spmd_axis_names=_axis_names_from_source(axes_src))
         return cfg
 
 
@@ -440,6 +524,19 @@ def _wire_names_from_source(source: str) -> frozenset:
     return frozenset()
 
 
+def _axis_names_from_source(source: str) -> frozenset:
+    """Declared mesh axis names of the axes module: the values of every
+    module-level string constant (DATA_AXIS = "data", ...) plus every
+    string reachable through a module-level tuple constant (AXIS_ORDER,
+    BATCH_AXES) — the registry the `spmd-collective` rule checks axis
+    arguments against."""
+    info = parse_module("<axes>", source)
+    names = set(info.consts.values())
+    for vals in info.tuple_consts.values():
+        names.update(vals)
+    return frozenset(names)
+
+
 @dataclass
 class LintContext:
     config: LintConfig
@@ -450,35 +547,69 @@ class LintContext:
 # Driver                                                                 #
 # --------------------------------------------------------------------- #
 
-def discover(root: str) -> Dict[str, str]:
-    """module key -> source for every .py under ``root`` (a package dir
-    or a standalone file — files inside a package are handled by
-    ``lint_path``, which lints the whole enclosing package so the
-    path-keyed rule configs and registries resolve)."""
-    files: Dict[str, str] = {}
+# mtime-keyed per-module parse cache (CLI/audit speed): repeated
+# lint_path runs in one process — the test suite, multi-target CLI
+# invocations, the sharding audit re-linting the package it just
+# extracted from — reparse only files whose (mtime_ns, size) changed
+_MODULE_CACHE: Dict[str, Tuple[int, int, str, ModuleInfo]] = {}
+
+
+def _cached_parse(path: str, key: str) -> ModuleInfo:
+    st = os.stat(path)
+    hit = _MODULE_CACHE.get(path)
+    if hit is not None and hit[0] == st.st_mtime_ns \
+            and hit[1] == st.st_size and hit[2] == key:
+        return hit[3]
+    with open(path, encoding="utf-8") as f:
+        info = parse_module(key, f.read())
+    _MODULE_CACHE[path] = (st.st_mtime_ns, st.st_size, key, info)
+    return info
+
+
+def discover_modules(root: str) -> Tuple[Dict[str, ModuleInfo],
+                                         List[Finding]]:
+    """Parsed modules for every .py under ``root``, through the mtime
+    cache.  Returns (module key -> ModuleInfo, parse-error findings)."""
+    modules: Dict[str, ModuleInfo] = {}
+    errors: List[Finding] = []
     root = os.path.abspath(root)
+    paths: List[Tuple[str, str]] = []
     if os.path.isfile(root):
-        with open(root, encoding="utf-8") as f:
-            files[os.path.basename(root)] = f.read()
-        return files
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            key = os.path.relpath(path, root).replace(os.sep, "/")
-            with open(path, encoding="utf-8") as f:
-                files[key] = f.read()
-    return files
+        paths.append((os.path.basename(root), root))
+    else:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    path = os.path.join(dirpath, fn)
+                    key = os.path.relpath(path, root).replace(os.sep, "/")
+                    paths.append((key, path))
+    for key, path in paths:
+        try:
+            modules[key] = _cached_parse(path, key)
+        except SyntaxError as e:
+            errors.append(Finding("parse", key, e.lineno or 0, 0,
+                                  f"syntax error: {e.msg}"))
+    return modules, errors
+
+
+def lint_modules(modules: Dict[str, ModuleInfo],
+                 config: Optional[LintConfig] = None,
+                 pre_findings: Optional[List[Finding]] = None
+                 ) -> List[Finding]:
+    """Lint pre-parsed modules (the cached-discovery path)."""
+    if config is None:
+        srcs = {k: "\n".join(m.lines) for k, m in modules.items()
+                if k in (LintConfig.knobs_module, LintConfig.wire_module,
+                         LintConfig.axes_module)}
+        config = LintConfig.for_tree(srcs)
+    return _lint_parsed(modules, config, list(pre_findings or []))
 
 
 def run_lint(files: Mapping[str, str],
              config: Optional[LintConfig] = None) -> List[Finding]:
     """Lint in-memory sources (module key -> source).  Returns ALL
     findings; suppressed ones carry ``suppressed=True``."""
-    from . import rules as rules_pkg
-
     if config is None:
         config = LintConfig.for_tree(files)
     modules: Dict[str, ModuleInfo] = {}
@@ -489,6 +620,13 @@ def run_lint(files: Mapping[str, str],
         except SyntaxError as e:
             findings.append(Finding("parse", key, e.lineno or 0, 0,
                                     f"syntax error: {e.msg}"))
+    return _lint_parsed(modules, config, findings)
+
+
+def _lint_parsed(modules: Dict[str, ModuleInfo], config: LintConfig,
+                 findings: List[Finding]) -> List[Finding]:
+    from . import rules as rules_pkg
+
     ctx = LintContext(config=config, modules=modules)
     for module in modules.values():
         for line in module.pragma_missing_reason:
@@ -537,9 +675,11 @@ def lint_path(root: str,
             # rule and report a false clean), then report only the
             # requested file's findings
             key = os.path.relpath(root_abs, pkg).replace(os.sep, "/")
-            return [f for f in run_lint(discover(pkg), config)
+            modules, errors = discover_modules(pkg)
+            return [f for f in lint_modules(modules, config, errors)
                     if f.path == key]
-    return run_lint(discover(root), config)
+    modules, errors = discover_modules(root)
+    return lint_modules(modules, config, errors)
 
 
 def report(findings: List[Finding], verbose: bool = False) -> Tuple[str, int]:
@@ -552,3 +692,23 @@ def report(findings: List[Finding], verbose: bool = False) -> Tuple[str, int]:
     lines.append(f"graftlint: {len(active)} finding(s), "
                  f"{n_sup} suppressed by pragma")
     return "\n".join(lines), (1 if active else 0)
+
+
+def report_json(findings: List[Finding],
+                target: Optional[str] = None) -> Dict[str, object]:
+    """Machine-readable findings (the CLI's ``--format json`` payload,
+    reused by CI and ``scripts/sharding_audit.py``): every finding —
+    suppressed ones included, flagged — plus the active/suppressed
+    counts and the exit code the text reporter would use."""
+    rows = [{"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+             "message": f.message, "suppressed": bool(f.suppressed)}
+            for f in findings]
+    active = sum(1 for f in findings if not f.suppressed)
+    out: Dict[str, object] = {
+        "schema": 1, "findings": rows, "active": active,
+        "suppressed": len(rows) - active,
+        "exit_code": 1 if active else 0,
+    }
+    if target is not None:
+        out["target"] = target
+    return out

@@ -3,18 +3,28 @@
 Each rule module exposes ``RULE`` (the name pragmas reference) and
 ``check(module, ctx) -> Iterable[Finding]``.
 
-- ``host-sync``      device->host synchronization in a hot path
-- ``retrace``        recompilation hazards at jit/shard_map boundaries
-- ``tracer-leak``    traced values escaping a jitted function
-- ``knob-registry``  RLA_TPU_* env reads outside the knobs registry
-- ``wire-exception`` typed raises in worker code missing from the wire
-                     reconstruction registry
+- ``host-sync``           device->host synchronization in a hot path
+- ``retrace``             recompilation hazards at jit/shard_map
+                          boundaries
+- ``tracer-leak``         traced values escaping a jitted function
+- ``knob-registry``       RLA_TPU_* env reads outside the knobs registry
+- ``wire-exception``      typed raises in worker code missing from the
+                          wire reconstruction registry
+- ``spmd-collective``     collective axis arguments that do not resolve
+                          to a declared mesh axis
+- ``rank-divergence``     rank-gated control flow enclosing collectives/
+                          barriers/commits; trace-time host
+                          nondeterminism in jitted SPMD bodies
+- ``sharding-inventory``  PartitionSpec literals outside the audited
+                          sharding modules (scripts/sharding_audit.py)
 """
 
-from . import (host_sync, knob_registry, retrace, tracer_leak,
+from . import (host_sync, knob_registry, rank_divergence, retrace,
+               sharding_inventory, spmd_collectives, tracer_leak,
                wire_exceptions)
 
 ALL_RULES = (host_sync, retrace, tracer_leak, knob_registry,
-             wire_exceptions)
+             wire_exceptions, spmd_collectives, rank_divergence,
+             sharding_inventory)
 
 RULE_NAMES = tuple(r.RULE for r in ALL_RULES)

@@ -1,0 +1,185 @@
+"""Rule ``rank-divergence``: host behavior that differs across ranks in
+SPMD code — the failure mode that hangs, not raises.
+
+Every rank of an SPMD program must issue the SAME collective sequence:
+a collective (or a cross-host barrier, or a collective checkpoint
+commit) that only SOME ranks reach deadlocks the others — a silent
+multi-minute stall the watchdog eventually reaps, with no exception
+pointing at the divergent branch.  Two statically checkable sources:
+
+- **rank-gated control flow**: a Python ``if``/``while`` whose test
+  depends on ``jax.process_index()`` (directly or through a local)
+  and whose body encloses a collective (``lax.psum``/``all_gather``/
+  ...), ``sync_global_devices``, or a checkpoint commit
+  (``save_sharded``/``save_checkpoint``/``wait_until_finished``).
+  Branching on ``process_count()`` is fine — every rank agrees on it.
+  Deliberate single-writer blocks (process 0 writing ``meta.json``)
+  carry a reasoned pragma.
+
+- **host nondeterminism in jitted SPMD bodies**: ``time.*`` /
+  ``random.*`` / ``np.random.*`` reachable from a function that is
+  jitted or used as a ``shard_map`` body (within-module call closure).
+  These run at TRACE time, per process — each rank bakes a different
+  constant (or traces a different program) into what must be one
+  identical SPMD program.  Seeded determinism threads a
+  ``jax.random`` key instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..lint import (Finding, LintContext, ModuleInfo, dotted,
+                    function_table, jitted_local_defs)
+from .spmd_collectives import is_collective_call
+
+RULE = "rank-divergence"
+
+# calls that participate in (or gate) cross-rank agreement: reaching
+# them on a subset of ranks is a deadlock / torn commit
+_BARRIER_LEAVES = frozenset(("sync_global_devices",))
+_COMMIT_LEAVES = frozenset(("save_sharded", "save_checkpoint",
+                            "restore_sharded", "wait_until_finished"))
+
+# host-nondeterminism call prefixes (module path up to the leaf);
+# numpy aliases the module actually imports are added per module
+_NONDET_PREFIXES = frozenset(("time", "random", "np.random",
+                              "numpy.random", "onp.random"))
+
+
+def _rank_locals(scope: ast.AST) -> Set[str]:
+    """Names assigned (anywhere in the scope) from an expression calling
+    ``process_index`` — rank-valued host integers."""
+    out: Set[str] = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(isinstance(sub, ast.Call)
+               and (dotted(sub.func) or "").split(".")[-1]
+               == "process_index"
+               for sub in ast.walk(node.value)):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _test_is_rank_divergent(test: ast.AST, rank_names: Set[str]) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call) \
+                and (dotted(sub.func) or "").split(".")[-1] \
+                == "process_index":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in rank_names:
+            return True
+    return False
+
+
+def _divergence_hazard(node: ast.AST) -> str:
+    """What a rank-gated branch body encloses that needs every rank:
+    'collective lax.<op>' / 'sync_global_devices' / 'checkpoint commit
+    <leaf>' — or '' when the branch is harmless host-local work."""
+    for sub in ast.walk(node):
+        op = is_collective_call(sub)
+        if op is not None:
+            return f"collective lax.{op}"
+        if isinstance(sub, ast.Call):
+            leaf = (dotted(sub.func) or "").split(".")[-1]
+            if leaf in _BARRIER_LEAVES:
+                return "sync_global_devices"
+            if leaf in _COMMIT_LEAVES:
+                return f"checkpoint commit '{leaf}'"
+    return ""
+
+
+def _jitted_reachable(module: ModuleInfo) -> Dict[str, ast.AST]:
+    """Functions that run under trace: jit/shard_map-bound defs in any
+    scope, plus the within-module closure of bare-name calls from them
+    (a helper called from a jitted body traces too)."""
+    table = function_table(module.tree)
+    scopes: List[ast.AST] = [module.tree]
+    scopes += [n for n in ast.walk(module.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))]
+    jitted: Dict[str, ast.AST] = {}
+    for scope in scopes:
+        for name, (fn, _static) in jitted_local_defs(scope).items():
+            jitted[name] = fn
+    # methods decorated @jax.jit are in jitted_local_defs via their
+    # ClassDef scope; also catch fns passed by dotted module alias?  No:
+    # cross-module jit bindings stay the caller's module's problem.
+    out: Dict[str, ast.AST] = {}
+    stack = list(jitted.items())
+    while stack:
+        name, fn = stack.pop()
+        if name in out:
+            continue
+        out[name] = fn
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Name) \
+                    and sub.func.id in table and sub.func.id not in out:
+                stack.append((sub.func.id, table[sub.func.id]))
+    return out
+
+
+def check(module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+
+    # ---- rank-gated control flow over collectives/barriers/commits --- #
+    scopes: List[ast.AST] = list(function_table(module.tree).values())
+    scopes.append(module.tree)
+    seen: Set[int] = set()
+    for scope in scopes:
+        rank_names = _rank_locals(scope)
+        for node in ast.walk(scope):
+            if not isinstance(node, (ast.If, ast.While)) \
+                    or id(node) in seen:
+                continue
+            seen.add(id(node))
+            if not _test_is_rank_divergent(node.test, rank_names):
+                continue
+            hazard = ""
+            # EVERY arm of a rank-divergent if is rank-divergent — the
+            # body, the else, and each elif (an elif body executes only
+            # on the rank subset that fell through the rank test), so
+            # the whole orelse subtree is scanned, nested Ifs included
+            for child in node.body + node.orelse:
+                hazard = hazard or _divergence_hazard(child)
+            if hazard:
+                kind = "while" if isinstance(node, ast.While) else "if"
+                findings.append(Finding(
+                    RULE, module.key, node.lineno, node.col_offset,
+                    f"host '{kind}' branching on process_index()/rank "
+                    f"encloses {hazard}: ranks that skip this branch "
+                    "never join it — a silent cross-rank deadlock (or "
+                    "torn commit), not an exception.  Hoist it out of "
+                    "the rank branch, or pragma with the single-writer "
+                    "rationale"))
+
+    # ---- host nondeterminism reachable from jitted SPMD bodies ------- #
+    nondet = set(_NONDET_PREFIXES)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    nondet.add(f"{a.asname or 'numpy'}.random")
+    for qualname, fn in _jitted_reachable(module).items():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if not name or "." not in name:
+                continue
+            mod = name.rsplit(".", 1)[0]
+            if mod in nondet:
+                findings.append(Finding(
+                    RULE, module.key, node.lineno, node.col_offset,
+                    f"'{name}(...)' reachable from jitted/shard_map "
+                    f"body '{qualname}': it runs at TRACE time per "
+                    "process, so each rank bakes a different host value "
+                    "into what must be one identical SPMD program — "
+                    "thread a seeded jax.random key (or hoist the host "
+                    "value out of the traced body)"))
+    return findings

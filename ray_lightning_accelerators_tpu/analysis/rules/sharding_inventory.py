@@ -1,0 +1,76 @@
+"""Rule ``sharding-inventory``: PartitionSpec literals stay on the
+inventoried surface.
+
+``scripts/sharding_audit.py`` extracts every ``PartitionSpec``
+declaration across the parallel modules + trainer/accelerators into one
+JSON inventory — the reconnaissance artifact for ROADMAP item 5's
+unified ShardingPlan.  That artifact is only trustworthy if new
+sharding logic cannot silently grow OUTSIDE the inventoried modules:
+this rule flags any ``PartitionSpec(...)`` / ``P(...)`` construction in
+a module missing from ``LintConfig.inventory_modules``.
+
+A legitimate out-of-inventory spec (a model applying its logical-rule
+specs through ``shard_constraint``) carries a reasoned pragma — the
+pragma is the paper trail the ShardingPlan refactor will collect.
+
+Detected spellings: ``jax.sharding.PartitionSpec(...)`` (any dotted
+path ending in ``PartitionSpec``), a name imported from
+``jax.sharding`` (``from jax.sharding import PartitionSpec as P``), and
+a local alias assigned from the dotted name
+(``P = jax.sharding.PartitionSpec``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..lint import Finding, LintContext, ModuleInfo, dotted
+
+RULE = "sharding-inventory"
+
+
+def _spec_aliases(module: ModuleInfo) -> Set[str]:
+    """Local names bound to the PartitionSpec class."""
+    aliases: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "jax.sharding":
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Assign) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = dotted(node.value)
+            if name and name.split(".")[-1] == "PartitionSpec":
+                aliases.add(node.targets[0].id)
+    return aliases
+
+
+def check(module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+    if any(module.key == m or module.key.endswith("/" + m)
+           for m in ctx.config.inventory_modules):
+        return []
+    aliases = _spec_aliases(module)
+    findings: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None:
+            continue
+        is_spec = (name.split(".")[-1] == "PartitionSpec"
+                   or name in aliases)
+        if not is_spec:
+            continue
+        findings.append(Finding(
+            RULE, module.key, node.lineno, node.col_offset,
+            f"PartitionSpec literal in uninventoried module "
+            f"{module.key!r}: sharding layouts are declared in the "
+            "audited modules (scripts/sharding_audit.py inventory — "
+            "parallel/*, core/trainer.py, accelerators/base.py) so the "
+            "ShardingPlan refactor (ROADMAP item 5) sees every layout "
+            "in one place — move the spec behind parallel/sharding.py's "
+            "rules, or pragma with why this module owns it"))
+    return findings

@@ -1,0 +1,264 @@
+"""Rule ``spmd-collective``: collective axis arguments must resolve to
+declared mesh axes.
+
+The repo now has five independent sources of collective logic
+(``parallel/collectives.py``, ``sharding.py``, ``ulysses.py``,
+``ring_attention.py``, ``pipeline.py``) plus collectives in the fused
+loss and the trainer's grad-norm hook.  The single consistency anchor is
+the axis-name registry in ``parallel/mesh.py`` (``DATA_AXIS`` ...
+``EXPERT_AXIS``, ``AXIS_ORDER``, ``BATCH_AXES``): every mesh is built
+over those names, every ``shard_map`` binds a subset of them, and a
+collective over any OTHER name is either a trace-time crash (unbound
+axis) or — in hand-rolled partial-manual code — a silently wrong
+program.  This rule closes the typo/drift hole statically: the
+``axis_name`` argument of every ``lax.psum/pmean/all_gather/all_to_all/
+psum_scatter/ppermute/axis_index`` call must resolve to declared axis
+names.
+
+Resolution (in order, all static):
+
+- a string literal / tuple of literals;
+- a module constant, a registered tuple constant (``BATCH_AXES``), an
+  imported constant, or a ``mesh_lib.FSDP_AXIS``-style attribute —
+  through the driver's constant/import-alias tables;
+- *axis-derived dataflow*: a local assigned from a resolvable
+  expression, from a comprehension/``tuple()``/``sorted()`` over an
+  axis-derived iterable, or from a call to an **axis function** — a
+  function of the linted tree whose every ``return`` is itself
+  axis-resolvable (``dp_axis_names``, ``_batch_axes_in``);
+- a function *parameter*: the axis identity flows from call sites,
+  which are themselves checked wherever they pass something concrete
+  (the ``shard_map``-body convention — ``ring_attention(q, k, v,
+  axis_name)`` is declared safe here, and the mesh-level wrapper's
+  ``axis_name=mesh_lib.SEQUENCE_AXIS`` is checked).
+
+Findings: a RESOLVED axis name missing from the declared set
+("collective over undeclared axis"), and an axis argument that resolves
+through none of the paths above ("unresolvable axis") — the hole where
+a new subsystem invents its own axis vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..lint import (Finding, LintContext, ModuleInfo, dotted,
+                    function_table, resolve_str_tuple)
+
+RULE = "spmd-collective"
+
+# op leaf name -> positional index of the axis_name argument
+COLLECTIVE_AXIS_ARG: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "all_to_all": 1, "psum_scatter": 1, "ppermute": 1, "axis_index": 0,
+}
+
+_DERIVING_BUILTINS = frozenset(("tuple", "list", "sorted", "set",
+                                "frozenset", "reversed"))
+
+
+def is_collective_call(node: ast.AST) -> Optional[str]:
+    """The collective op name when ``node`` is a ``lax.<op>`` /
+    ``jax.lax.<op>`` call (any alias whose trailing module segment is
+    ``lax``), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted(node.func)
+    if not name or "." not in name:
+        return None
+    mod, leaf = name.rsplit(".", 1)
+    if leaf in COLLECTIVE_AXIS_ARG and mod.split(".")[-1] == "lax":
+        return leaf
+    return None
+
+
+def axis_arg_of(node: ast.Call, op: str) -> Optional[ast.AST]:
+    """The axis_name argument expression of a collective call (or None
+    when the call omits it — jax raises there, not this rule)."""
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = COLLECTIVE_AXIS_ARG[op]
+    if len(node.args) > idx:
+        return node.args[idx]
+    return None
+
+
+# --------------------------------------------------------------------- #
+# Axis functions: tree functions whose returns always resolve to axes   #
+# --------------------------------------------------------------------- #
+def _function_node(ctx: LintContext, module: ModuleInfo,
+                   func: ast.AST) -> Optional[Tuple[ModuleInfo, str]]:
+    """(module, qualname) of the tree function a call target names:
+    bare ``f`` in the same module, imported ``f``, or ``mod_alias.f``."""
+    if isinstance(func, ast.Name):
+        if func.id in function_table(module.tree):
+            return module, func.id
+        imp = module.imported_names.get(func.id)
+        if imp is not None:
+            target = ctx.modules.get(imp[0])
+            if target is not None and imp[1] in function_table(target.tree):
+                return target, imp[1]
+        return None
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        modkey = module.mod_aliases.get(func.value.id)
+        if modkey is not None:
+            target = ctx.modules.get(modkey)
+            if target is not None \
+                    and func.attr in function_table(target.tree):
+                return target, func.attr
+    return None
+
+
+def _is_axis_function(ctx: LintContext, module: ModuleInfo, func: ast.AST,
+                      _depth: int = 0) -> bool:
+    """True when the called function's every ``return`` expression is
+    axis-derived (parameters allowed — they are the caller's problem).
+    Depth-limited so mutual recursion cannot loop."""
+    if _depth > 3:
+        return False
+    hit = _function_node(ctx, module, func)
+    if hit is None:
+        return False
+    target_mod, qualname = hit
+    fn = function_table(target_mod.tree)[qualname]
+    params = {a.arg for a in fn.args.args}
+    returns = [n for n in ast.walk(fn)
+               if isinstance(n, ast.Return) and n.value is not None]
+    if not returns:
+        return False
+    return all(
+        _axis_derived(ctx, target_mod, r.value, set(), params,
+                      _depth=_depth + 1)
+        for r in returns)
+
+
+def _axis_derived(ctx: LintContext, module: ModuleInfo, expr: ast.AST,
+                  axis_locals: Set[str], params: Set[str],
+                  _depth: int = 0) -> bool:
+    """Does ``expr`` carry axis names by construction (without resolving
+    to a concrete set)?  Conservative recursive dataflow."""
+    if resolve_str_tuple(ctx, module, expr) is not None:
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in axis_locals or expr.id in params
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return all(_axis_derived(ctx, module, e, axis_locals, params,
+                                 _depth) for e in expr.elts)
+    if isinstance(expr, ast.Starred):
+        return _axis_derived(ctx, module, expr.value, axis_locals, params,
+                             _depth)
+    if isinstance(expr, ast.IfExp):
+        return (_axis_derived(ctx, module, expr.body, axis_locals, params,
+                              _depth)
+                and _axis_derived(ctx, module, expr.orelse, axis_locals,
+                                  params, _depth))
+    if isinstance(expr, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+        inner = set(axis_locals)
+        for gen in expr.generators:
+            if not _axis_derived(ctx, module, gen.iter, inner, params,
+                                 _depth):
+                return False
+            for n in ast.walk(gen.target):
+                if isinstance(n, ast.Name):
+                    inner.add(n.id)
+        return _axis_derived(ctx, module, expr.elt, inner, params, _depth)
+    if isinstance(expr, ast.Subscript):
+        # axes[0] / axes[1:] of an axis-derived tuple
+        return _axis_derived(ctx, module, expr.value, axis_locals, params,
+                             _depth)
+    if isinstance(expr, ast.Call):
+        fname = dotted(expr.func)
+        if fname and fname.split(".")[-1] in _DERIVING_BUILTINS \
+                and expr.args:
+            return _axis_derived(ctx, module, expr.args[0], axis_locals,
+                                 params, _depth)
+        return _is_axis_function(ctx, module, expr.func, _depth)
+    return False
+
+
+def _scope_env(ctx: LintContext, module: ModuleInfo,
+               scope: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(axis_locals, params) for one top-level function scope — params
+    of the function and every nested def, plus a small fixed point over
+    assignments whose RHS is axis-derived (nested ``body`` closures see
+    the enclosing builder's ``axes``/``data_axes`` locals)."""
+    params: Set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            args = node.args
+            for a in (args.args + args.posonlyargs + args.kwonlyargs):
+                params.add(a.arg)
+            if args.vararg:
+                params.add(args.vararg.arg)
+            if args.kwarg:
+                params.add(args.kwarg.arg)
+    params.discard("self")
+    axis_locals: Set[str] = set()
+    for _ in range(3):  # fixed point: chains like a = X; b = tuple(a)
+        grew = False
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _axis_derived(ctx, module, node.value, axis_locals,
+                                 params):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id not in axis_locals:
+                    axis_locals.add(tgt.id)
+                    grew = True
+        if not grew:
+            break
+    return axis_locals, params
+
+
+def check(module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+    declared = ctx.config.spmd_axis_names
+    if not declared:
+        return []  # no axes module in this tree: nothing to check against
+    findings: List[Finding] = []
+    scopes: List[ast.AST] = list(function_table(module.tree).values())
+    scopes += [n for n in module.tree.body
+               if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))]
+    seen: Set[int] = set()
+    for scope in scopes:
+        env = None  # lazy: most scopes contain no collectives
+        for node in ast.walk(scope):
+            op = is_collective_call(node)
+            if op is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            axis_expr = axis_arg_of(node, op)
+            if axis_expr is None:
+                continue
+            names = resolve_str_tuple(ctx, module, axis_expr)
+            if names is not None:
+                unknown = sorted(set(names) - declared)
+                if unknown:
+                    findings.append(Finding(
+                        RULE, module.key, node.lineno, node.col_offset,
+                        f"'lax.{op}' over undeclared axis name(s) "
+                        f"{unknown}: mesh axes are declared in "
+                        "parallel/mesh.py (DATA_AXIS..EXPERT_AXIS / "
+                        "AXIS_ORDER / BATCH_AXES) — a collective over "
+                        "any other name is an unbound-axis trace error "
+                        "or a silent cross-subsystem axis-meaning "
+                        "mismatch"))
+                continue
+            if env is None:
+                env = _scope_env(ctx, module, scope)
+            axis_locals, params = env
+            if _axis_derived(ctx, module, axis_expr, axis_locals, params):
+                continue
+            findings.append(Finding(
+                RULE, module.key, node.lineno, node.col_offset,
+                f"'lax.{op}' axis argument does not resolve to a "
+                "declared mesh axis (not a literal/registered constant, "
+                "not derived from one, not a parameter): route the axis "
+                "through parallel/mesh.py's named constants so the SPMD "
+                "layer keeps one axis vocabulary"))
+    return findings

@@ -1477,10 +1477,15 @@ class Trainer:
         a machine-readable diagnosis on ``last_stall_diagnosis`` (and the
         log) before re-raising."""
         from ..runtime.watchdog import (WorkerWedged, stall_record)
+        from ..testing import spmd_sanitizer
         self.last_stall_diagnosis = None
+        # opt-in SPMD sanitizer (RLA_TPU_SPMD_SANITIZER): this run must
+        # only ever be diffed against sequences ITS workers trace — not
+        # a previous run's (or a smaller world's leftover) spills
+        spmd_sanitizer.reset_world_collectives()
         try:
-            return world.run(body, queue=queue,
-                             deadline_s=self.worker_deadline_s)
+            results = world.run(body, queue=queue,
+                                deadline_s=self.worker_deadline_s)
         except BaseException as e:
             self._world = None
             module.trainer = self
@@ -1498,12 +1503,45 @@ class Trainer:
                 self.last_stall_diagnosis = record
                 log.error("stall diagnosis: %s",
                           json.dumps(record, sort_keys=True, default=str))
+                # the worst SPMD failure mode decoded: when the wedge's
+                # real cause is a rank-divergent collective, the spilled
+                # sequences disagree — surface the typed mismatch naming
+                # the first divergent call instead of the generic wedge
+                mismatch = None
+                try:
+                    mismatch = spmd_sanitizer.check_world_collectives(
+                        raise_on_mismatch=False)
+                except Exception:  # the postmortem must not mask e
+                    pass
+                if mismatch is not None:
+                    self._write_failure_report(mismatch)
+                    raise mismatch from e
             # postmortem artifact: the pool is already gone (world.run
             # kills it on failure), so rank timelines come from the
             # telemetry-dir spill files — the channel built to survive
             # exactly this
             self._write_failure_report(e)
             raise
+        # even a run that COMPLETED may have traced divergent collective
+        # sequences (divergence hangs only when the mismatched
+        # collective actually executes) — diff the rank spills and
+        # refuse to call it a success.  Unlike the except path, the
+        # world is still ALIVE here: its workers traced poison, so end
+        # it explicitly before surfacing the typed mismatch.
+        mismatch = spmd_sanitizer.check_world_collectives(
+            raise_on_mismatch=False)
+        if mismatch is not None:
+            try:
+                world.shutdown()
+            except Exception:
+                pass
+            self._world = None
+            module.trainer = self
+            self.module = module
+            self.fitting = False
+            self._write_failure_report(mismatch)
+            raise mismatch
+        return results
 
     def shutdown_workers(self) -> None:
         """End the persistent fan-out world (spawned agent workers + their

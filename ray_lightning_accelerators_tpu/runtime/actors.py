@@ -62,6 +62,15 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
         telemetry.configure(rank=rank, env=env)
     except Exception:
         telemetry = None
+    # opt-in SPMD collective sanitizer (testing/spmd_sanitizer.py):
+    # when RLA_TPU_SPMD_SANITIZER is in the overlay, every collective
+    # this worker traces is recorded + spilled rank-keyed so the driver
+    # can diff sequences across ranks.  Observes, never gates.
+    try:
+        from ..testing.spmd_sanitizer import maybe_install_from_env
+        maybe_install_from_env(rank=rank, env=env)
+    except Exception:
+        pass
     try:
         # the package logger was configured at import, BEFORE the
         # per-worker overlay landed in os.environ — re-read

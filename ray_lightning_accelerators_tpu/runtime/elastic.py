@@ -50,7 +50,7 @@ from ..utils.logging import log
 from . import preemption as preempt_lib
 from .actors import ActorPool
 from .queue import TrampolineQueue, process_results
-from .watchdog import Watchdog, wedge_timeout_from_env
+from .watchdog import Watchdog, WorkerWedged, wedge_timeout_from_env
 
 BACKOFF_BASE_ENV = "RLA_TPU_ELASTIC_BACKOFF_S"
 BACKOFF_CAP_ENV = "RLA_TPU_ELASTIC_BACKOFF_CAP_S"
@@ -186,6 +186,34 @@ class ElasticRunner:
                 or self.dispatch_deadline_s is not None
                 or wedge_timeout_from_env() is not None)
 
+    def _collective_mismatch(self, exc: BaseException):
+        """The SPMD sanitizer's verdict on a failed attempt (no-op
+        unless RLA_TPU_SPMD_SANITIZER + a telemetry dir are configured):
+        a typed CollectiveMismatch when the rank spills diverge, else
+        None.  Only HANG-shaped failures (WorkerWedged / TimeoutError)
+        are decoded — a crashed rank's spill is legitimately truncated
+        mid-trace, and reading that as divergence would turn every
+        retryable crash into a terminal mismatch.  Best-effort —
+        diagnosing must never mask the failure."""
+        if not isinstance(exc, (WorkerWedged, TimeoutError)):
+            return None
+        try:
+            from ..testing import spmd_sanitizer
+            return spmd_sanitizer.check_world_collectives(
+                raise_on_mismatch=False)
+        except Exception:
+            return None
+
+    def _reset_collectives(self) -> None:
+        """Attempt-entry spill reset (same knob gating): an attempt is
+        never diffed against a previous attempt's (or run's) sequences.
+        Restarted workers rewrite their spill at boot install."""
+        try:
+            from ..testing import spmd_sanitizer
+            spmd_sanitizer.reset_world_collectives()
+        except Exception:
+            pass
+
     def _build_args(self, args_per_worker, attempt: int) -> Sequence[tuple]:
         """Per-rank argument tuples; callables accepting a second
         parameter receive the CURRENT world size (required under
@@ -227,6 +255,9 @@ class ElasticRunner:
             log.warning("elastic backoff %.2fs before attempt %d",
                         delay, attempt + 1)
             time.sleep(delay)
+        # cleared BEFORE the restart: every respawned rank rewrites its
+        # spill at boot install, so the retry diffs only its own traces
+        self._reset_collectives()
         restarted = self.pool.restart_all(
             init_hook=None if self.allow_shrink else self.init_hook)
         log.warning("elastic attempt %d (restarted ranks %s)",
@@ -269,6 +300,9 @@ class ElasticRunner:
         failures = 0
         preemptions = 0
         self.goodput.run_begin()
+        # a fresh run must not inherit a previous run's (or a smaller
+        # world's leftover) collective sequences
+        self._reset_collectives()
         while True:
             self.attempts_used = attempt + 1
             self.goodput.note_attempt()
@@ -342,6 +376,15 @@ class ElasticRunner:
                                 "from emergency checkpoint",
                                 attempt + 1, preempted)
                 else:
+                    mismatch = self._collective_mismatch(e)
+                    if mismatch is not None:
+                        # a rank-divergent collective (opt-in sanitizer,
+                        # RLA_TPU_SPMD_SANITIZER) is DETERMINISTIC: every
+                        # retry would trace the same divergent programs
+                        # and hang again — surface the typed postmortem
+                        # terminally instead of burning the budget
+                        self._write_report(mismatch)
+                        raise mismatch from e
                     failures += 1
                     telemetry.emit("elastic_failure",
                                    attempt=attempt + 1,

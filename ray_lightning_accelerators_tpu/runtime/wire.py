@@ -33,12 +33,14 @@ WIRE_EXCEPTION_NAMES = frozenset({
     "ElasticResizeError",
     "QueueShutdown",
     "ObjectStoreError",
+    "CollectiveMismatch",
 })
 
 
 def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
     # imported lazily: wire.py must stay importable from any runtime
     # module without creating cycles
+    from ..testing.spmd_sanitizer import CollectiveMismatch
     from .elastic import ElasticResizeError
     from .object_store import ObjectStoreError
     from .preemption import Preempted
@@ -51,6 +53,7 @@ def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
         "ElasticResizeError": ElasticResizeError,
         "QueueShutdown": QueueShutdown,
         "ObjectStoreError": ObjectStoreError,
+        "CollectiveMismatch": CollectiveMismatch.from_message,
     }
 
 

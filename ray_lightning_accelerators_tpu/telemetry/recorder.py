@@ -82,6 +82,11 @@ EVENT_KINDS = frozenset({
     # perf observatory (telemetry/perf.py): the HBM ledger saw placed
     # bytes grow monotonically for a whole leak streak
     "hbm_leak",
+    # SPMD sanitizer (testing/spmd_sanitizer.py): one traced collective
+    # call recorded while the opt-in sanitizer is installed — the
+    # unified timeline's view of the per-rank collective sequence (the
+    # authoritative diff channel is the sanitizer's own spill file)
+    "spmd_collective",
     # worker dispatch loop (runtime/actors.py)
     "dispatch_begin", "dispatch_end",
     # supervision / retry layers (runtime/watchdog.py, runtime/elastic.py)

@@ -1,8 +1,14 @@
-"""Test-support subsystems (deterministic fault injection lives in
-``testing.chaos``).  Import-light: nothing here pulls in jax."""
+"""Test-support subsystems: deterministic fault injection
+(``testing.chaos``) and the cross-rank collective sanitizer
+(``testing.spmd_sanitizer``).  Import-light: nothing here pulls in jax
+(the sanitizer patches jax.lax only when ``install()`` runs)."""
 
 from .chaos import (CHAOS_ENV, CHAOS_EXIT_CODE, CHAOS_NS_ENV, ChaosFault,
                     ChaosInjector, parse_chaos)
+from .spmd_sanitizer import (SANITIZER_ENV, CollectiveMismatch,
+                             SpmdSanitizer, check_collective_sequences)
 
 __all__ = ["CHAOS_ENV", "CHAOS_EXIT_CODE", "CHAOS_NS_ENV", "ChaosFault",
-           "ChaosInjector", "parse_chaos"]
+           "ChaosInjector", "parse_chaos", "SANITIZER_ENV",
+           "CollectiveMismatch", "SpmdSanitizer",
+           "check_collective_sequences"]

@@ -1,0 +1,475 @@
+"""Cross-rank collective sanitizer: record every traced collective,
+diff the sequences across ranks, turn the silent SPMD deadlock into a
+typed one-look postmortem.
+
+The worst SPMD failure mode is not an exception — it is a *hang*: one
+rank's program issues a collective the others never join (a
+rank-divergent branch, a mismatched axis, two subsystems disagreeing
+about an exchange order) and every healthy rank blocks inside XLA until
+the watchdog reaps the world minutes later, with a diagnosis that says
+"stopped making progress" and nothing about WHY.  The static rules
+(``analysis/rules/spmd_collectives.py`` / ``rank_divergence.py``) close
+the statically visible holes; this module is the runtime net under
+everything they cannot see.
+
+Design (opt-in via the ``RLA_TPU_SPMD_SANITIZER`` knob + the
+``spmd_sanitizer`` conftest fixture):
+
+- **Interception.**  ``install()`` wraps the public ``jax.lax``
+  collective entry points (``psum``/``pmean``/``all_gather``/
+  ``all_to_all``/``psum_scatter``/``ppermute``/``axis_index`` — exactly
+  the ops the repo's exchange/gather builders in
+  ``parallel/collectives.py``, the fused loss, ulysses/ring/pipeline
+  call).  Collectives execute Python only at TRACE time, so the wrapper
+  costs nothing per step: each traced call appends one host-side record
+  ``(op, axis names, shape, dtype, call site)`` to a bounded ring
+  (``RLA_TPU_SPMD_SEQ_EVENTS``) and mirrors a compact event into the
+  PR 7 flight recorder (kind ``spmd_collective``) so the unified
+  timeline shows the collective stream in context.
+
+- **Spill.**  Every record re-snapshots ``rank{N}.collectives.json``
+  under ``RLA_TPU_TELEMETRY_DIR`` (atomic tmp+rename, same contract as
+  the flight recorder's spill): a rank that wedges mid-collective
+  leaves its sequence on disk, which is the whole point.  Worker
+  processes auto-install at boot (``runtime/actors._worker_main``) when
+  the knob is in their env overlay.
+
+- **The checker.**  ``check_collective_sequences(dir)`` gathers every
+  rank's spill, aligns on absolute call index and raises a typed,
+  wire-registered :class:`CollectiveMismatch` whose diagnosis embeds
+  the FIRST divergent entry per rank (op/axes/shape/dtype/site).  The
+  driver runs it after fan-out runs (``Trainer._run_in_world``) and
+  chaos attempts (``runtime/elastic.ElasticRunner``) — a wedge whose
+  real cause is a divergent collective surfaces as
+  ``CollectiveMismatch`` naming the divergent call, not as a generic
+  ``WorkerWedged``.
+
+Import-light by design: nothing here imports jax until ``install()``
+actually patches it, so the testing package stays a zero-cost import.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import threading
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from ..analysis import knobs
+from ..telemetry import recorder as telemetry
+
+SANITIZER_ENV = "RLA_TPU_SPMD_SANITIZER"
+SEQ_EVENTS_ENV = "RLA_TPU_SPMD_SEQ_EVENTS"
+DEFAULT_SEQ_EVENTS = 512
+
+# the jax.lax entry points wrapped while the sanitizer is installed —
+# the ops the repo's exchange/gather builders and parallel modules use
+COLLECTIVE_OPS: Tuple[str, ...] = (
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "ppermute", "axis_index")
+
+_SPILL_SUFFIX = ".collectives.json"
+
+
+class CollectiveMismatch(RuntimeError):
+    """Ranks traced DIVERGENT collective sequences: the program that
+    hangs (or silently corrupts) instead of raising.  The diagnosis
+    carries the first divergent entry per rank — op, axis names, shape,
+    dtype and call site — so the postmortem names the exact call.
+
+    Wire-registered (``runtime/wire.py``): a worker- or driver-side
+    raise crosses the actor pipe and the agent relay typed, with the
+    diagnosis embedded in the message (the ``WorkerWedged`` marker
+    pattern) and recovered by :meth:`from_message`.
+    """
+
+    _MARKER = "| collectives="
+
+    def __init__(self, message: str,
+                 diagnosis: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.diagnosis = dict(diagnosis or {})
+
+    @classmethod
+    def from_divergence(cls, diagnosis: Dict[str, Any]
+                        ) -> "CollectiveMismatch":
+        diagnosis = dict(diagnosis)
+        idx = diagnosis.get("first_divergence")
+        per_rank = diagnosis.get("per_rank") or {}
+        bits = []
+        for rank in sorted(per_rank):
+            e = per_rank[rank]
+            if e is None:
+                bits.append(f"rank {rank}: <no call #{idx}>")
+            else:
+                bits.append(
+                    f"rank {rank}: {e.get('op')}(axes={e.get('axes')}, "
+                    f"shape={e.get('shape')}, dtype={e.get('dtype')}) "
+                    f"at {e.get('site')}")
+        msg = (f"cross-rank collective sequences diverge at call "
+               f"#{idx}: " + "; ".join(bits) + " "
+               + cls._MARKER
+               + json.dumps(diagnosis, sort_keys=True, default=str))
+        return cls(msg, diagnosis=diagnosis)
+
+    @classmethod
+    def from_message(cls, message: str) -> "CollectiveMismatch":
+        """Rebuild from a wire-crossing (name, message, tb) payload,
+        recovering the embedded diagnosis."""
+        diagnosis: Dict[str, Any] = {}
+        i = message.find(cls._MARKER)
+        if i >= 0:
+            try:
+                diagnosis = json.loads(message[i + len(cls._MARKER):])
+            except ValueError:
+                pass
+        return cls(message, diagnosis=diagnosis)
+
+
+# --------------------------------------------------------------------- #
+# Recording                                                              #
+# --------------------------------------------------------------------- #
+def _norm_axes(axis_name: Any) -> List[str]:
+    if axis_name is None:
+        return []
+    if isinstance(axis_name, (tuple, list)):
+        return [str(a) for a in axis_name]
+    return [str(axis_name)]
+
+
+def _shape_dtype(x: Any) -> Tuple[Optional[List[int]], Optional[str]]:
+    """Host metadata of the first array-ish leaf of ``x`` (a tracer at
+    record time — shape/dtype reads never sync a device)."""
+    if x is None:
+        return None, None
+    leaves = [x]
+    if not hasattr(x, "shape"):
+        try:
+            import jax
+            leaves = jax.tree_util.tree_leaves(x)
+        except Exception:
+            return None, None
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            dtype = getattr(leaf, "dtype", None)
+            return list(shape), (str(dtype) if dtype is not None else None)
+    return None, None
+
+
+def _call_site(depth: int = 2) -> Optional[str]:
+    """``path:lineno`` of the frame that called the wrapped collective,
+    trimmed to a package/repo-relative tail for cross-process
+    comparability."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return None
+    path = frame.f_code.co_filename
+    parts = path.replace(os.sep, "/").split("/")
+    tail = "/".join(parts[-3:]) if len(parts) > 3 else "/".join(parts)
+    return f"{tail}:{frame.f_lineno}"
+
+
+class SpmdSanitizer:
+    """One process's bounded collective-call sequence.
+
+    Entries carry a monotonically increasing absolute index ``i`` so
+    sequences stay alignable across ranks even after the ring drops old
+    heads.  Thread-safe (serve threads and a fitting trainer may trace
+    concurrently); every record re-spills — tracing is rare, so the
+    extra write is noise, and crash-observability is the contract."""
+
+    def __init__(self, capacity: int = DEFAULT_SEQ_EVENTS,
+                 rank: Optional[int] = None,
+                 spill_path: Optional[str] = None):
+        self.capacity = max(1, int(capacity))
+        self.rank = rank
+        self.spill_path = spill_path
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._n = 0
+        self._lock = threading.Lock()
+        self._spill_warned = False
+
+    def record(self, op: str, axis_name: Any, x: Any = None,
+               site: Optional[str] = None) -> None:
+        axes = _norm_axes(axis_name)
+        shape, dtype = _shape_dtype(x)
+        with self._lock:
+            entry = {"i": self._n, "op": op, "axes": axes,
+                     "shape": shape, "dtype": dtype, "site": site}
+            self._ring.append(entry)
+            self._n += 1
+        # the unified timeline's view (bounded flight-recorder ring);
+        # the sanitizer's own spill below stays the diff channel
+        telemetry.emit("spmd_collective", op=op, axes=",".join(axes),
+                       site=site)
+        self.spill()
+
+    def sequence(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"rank": self.rank, "pid": os.getpid(),
+                "n": self._n, "capacity": self.capacity,
+                "events": self.sequence()}
+
+    def spill(self) -> Optional[str]:
+        """Atomic snapshot to ``spill_path`` — never raises (same
+        telemetry-observes-never-gates contract as the recorder).  The
+        tmp name is pid+thread-keyed: two threads tracing concurrently
+        (serve replica + fitting trainer) each write their OWN tmp and
+        the atomic replace publishes whichever complete snapshot lands
+        last — never an interleaved torn file."""
+        path = self.spill_path
+        if path is None:
+            return None
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f)
+            os.replace(tmp, path)
+            return path
+        except Exception as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            if not self._spill_warned:
+                self._spill_warned = True
+                telemetry.log.warning(
+                    "spmd sanitizer spill to %s failed: %s", path, e)
+            return None
+
+
+# --------------------------------------------------------------------- #
+# Installation (jax.lax patching) + process singleton                    #
+# --------------------------------------------------------------------- #
+_active: Optional[SpmdSanitizer] = None
+_originals: Dict[str, Any] = {}
+_install_lock = threading.Lock()
+
+
+def _make_wrapper(op: str, orig, sanitizer: SpmdSanitizer):
+    axis_idx = 0 if op == "axis_index" else 1
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        axis = kwargs.get("axis_name")
+        if axis is None and len(args) > axis_idx:
+            axis = args[axis_idx]
+        x = None if op == "axis_index" else (args[0] if args else None)
+        try:
+            sanitizer.record(op, axis, x, site=_call_site())
+        except Exception:
+            pass  # the sanitizer observes; it must never fail a trace
+        return orig(*args, **kwargs)
+
+    wrapper._rla_spmd_wrapped = True
+    return wrapper
+
+
+def enabled(env: Optional[Mapping[str, str]] = None) -> bool:
+    return knobs.get_bool(SANITIZER_ENV, False, env=env)
+
+
+def spill_path_for(rank: Optional[int],
+                   env: Optional[Mapping[str, str]] = None
+                   ) -> Optional[str]:
+    tdir = knobs.get_str(telemetry.DIR_ENV, None, env=env)
+    if not tdir:
+        return None
+    label = "driver" if rank is None else f"rank{int(rank)}"
+    return os.path.join(tdir, label + _SPILL_SUFFIX)
+
+
+def get_sanitizer() -> Optional[SpmdSanitizer]:
+    return _active
+
+
+def install(sanitizer: Optional[SpmdSanitizer] = None,
+            rank: Optional[int] = None,
+            env: Optional[Mapping[str, str]] = None) -> SpmdSanitizer:
+    """Patch the ``jax.lax`` collective entry points with recording
+    wrappers.  Idempotent per process (a second install rebinds the
+    ring, not the patches)."""
+    global _active
+    import jax
+
+    with _install_lock:
+        if sanitizer is None:
+            sanitizer = SpmdSanitizer(
+                capacity=knobs.get_int(SEQ_EVENTS_ENV, DEFAULT_SEQ_EVENTS,
+                                       env=env),
+                rank=rank, spill_path=spill_path_for(rank, env=env))
+        # overwrite any STALE spill from a previous process generation of
+        # this rank right away (worker restarts between elastic attempts
+        # re-run boot install): an attempt must never be diffed against
+        # a dead generation's sequence
+        sanitizer.spill()
+        for op in COLLECTIVE_OPS:
+            current = getattr(jax.lax, op, None)
+            if current is None:
+                continue
+            if getattr(current, "_rla_spmd_wrapped", False):
+                # already patched: rebuild the wrapper over the saved
+                # original so it records into the NEW ring
+                current = _originals[op]
+            else:
+                _originals[op] = current
+            setattr(jax.lax, op, _make_wrapper(op, current, sanitizer))
+        _active = sanitizer
+    return sanitizer
+
+
+def uninstall() -> None:
+    """Restore the original ``jax.lax`` entry points."""
+    global _active
+    with _install_lock:
+        if _originals:
+            import jax
+            for op, orig in _originals.items():
+                setattr(jax.lax, op, orig)
+            _originals.clear()
+        _active = None
+
+
+def maybe_install_from_env(rank: Optional[int] = None,
+                           env: Optional[Mapping[str, str]] = None
+                           ) -> Optional[SpmdSanitizer]:
+    """Worker-boot hook (``runtime/actors._worker_main``): install when
+    the knob is set in the per-worker overlay / process env."""
+    if not enabled(env):
+        return None
+    return install(rank=rank, env=env)
+
+
+# --------------------------------------------------------------------- #
+# Driver-side checker                                                    #
+# --------------------------------------------------------------------- #
+def clear_spills(tdir: Optional[str] = None,
+                 env: Optional[Mapping[str, str]] = None) -> None:
+    """Remove every ``*.collectives.json`` under the telemetry dir — the
+    driver calls this at run entry so a smaller world (or a rerun in
+    the same dir) is never diffed against stale rank files left by a
+    previous run.  Workers re-spill on boot and on every record, so
+    anything a live run traces reappears immediately."""
+    tdir = tdir or knobs.get_str(telemetry.DIR_ENV, None, env=env)
+    if not tdir or not os.path.isdir(tdir):
+        return
+    for fn in os.listdir(tdir):
+        if fn.endswith(_SPILL_SUFFIX):
+            try:
+                os.unlink(os.path.join(tdir, fn))
+            except OSError:
+                pass
+
+
+def gather_sequences(tdir: Optional[str] = None
+                     ) -> Dict[str, Dict[str, Any]]:
+    """label ('driver' / 'rank0' / ...) -> spilled sequence snapshot for
+    every ``*.collectives.json`` under the telemetry dir."""
+    tdir = tdir or knobs.get_str(telemetry.DIR_ENV, None)
+    out: Dict[str, Dict[str, Any]] = {}
+    if not tdir or not os.path.isdir(tdir):
+        return out
+    for fn in sorted(os.listdir(tdir)):
+        if not fn.endswith(_SPILL_SUFFIX):
+            continue
+        try:
+            with open(os.path.join(tdir, fn)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue  # torn mid-crash files are an expected state
+        if isinstance(snap, dict):
+            out[fn[:-len(_SPILL_SUFFIX)]] = snap
+    return out
+
+
+def _entry_key(e: Dict[str, Any]) -> Tuple:
+    return (e.get("op"), tuple(e.get("axes") or ()),
+            tuple(e.get("shape") or ()) if e.get("shape") is not None
+            else None,
+            e.get("dtype"), e.get("site"))
+
+
+def diff_sequences(snapshots: Mapping[str, Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """The divergence diagnosis across >= 2 rank sequences, or None when
+    every rank traced the same collective stream.
+
+    Sequences align on the absolute call index ``i`` (rings may have
+    dropped old heads on busy ranks); comparison starts at the highest
+    retained start index and runs to the longest sequence — a rank
+    whose stream ENDS early (it never issued call #k the others did) is
+    a divergence too, reported with ``None`` as its entry."""
+    ranks = {label: snap for label, snap in snapshots.items()
+             if label != "driver"}
+    if len(ranks) < 2:
+        return None
+    by_rank: Dict[str, Dict[int, Dict[str, Any]]] = {}
+    starts, ends = [], []
+    for label, snap in ranks.items():
+        events = snap.get("events") or []
+        by_rank[label] = {int(e["i"]): e for e in events}
+        starts.append(min(by_rank[label]) if by_rank[label] else 0)
+        ends.append(snap.get("n", len(events)))
+    lo, hi = max(starts), max(ends)
+    for i in range(lo, hi):
+        entries = {label: by_rank[label].get(i) for label in by_rank}
+        keys = {None if e is None else _entry_key(e)
+                for e in entries.values()}
+        if len(keys) > 1:
+            return {
+                "first_divergence": i,
+                "per_rank": entries,
+                "lengths": {label: snap.get("n")
+                            for label, snap in ranks.items()},
+                "ring_dropped": lo > 0,
+            }
+    return None
+
+
+def check_collective_sequences(tdir: Optional[str] = None,
+                               raise_on_mismatch: bool = True
+                               ) -> Optional[CollectiveMismatch]:
+    """Gather + diff the rank sequences under the telemetry dir; raise
+    (or return, with ``raise_on_mismatch=False``) the typed
+    :class:`CollectiveMismatch`.  None when the sequences agree."""
+    diagnosis = diff_sequences(gather_sequences(tdir))
+    if diagnosis is None:
+        return None
+    exc = CollectiveMismatch.from_divergence(diagnosis)
+    if raise_on_mismatch:
+        raise exc
+    return exc
+
+
+def check_world_collectives(raise_on_mismatch: bool = True,
+                            env: Optional[Mapping[str, str]] = None
+                            ) -> Optional[CollectiveMismatch]:
+    """The driver seam (trainer fan-out, elastic attempts): a no-op
+    unless the sanitizer knob is on AND a telemetry dir is configured —
+    unconfigured runs pay nothing, not even a directory listing."""
+    if not enabled(env):
+        return None
+    tdir = knobs.get_str(telemetry.DIR_ENV, None, env=env)
+    if not tdir:
+        return None
+    return check_collective_sequences(
+        tdir, raise_on_mismatch=raise_on_mismatch)
+
+
+def reset_world_collectives(env: Optional[Mapping[str, str]] = None
+                            ) -> None:
+    """Run-entry counterpart of :func:`check_world_collectives` (same
+    gating): clear stale rank spills so this run's diff only ever sees
+    sequences its own workers traced."""
+    if not enabled(env):
+        return
+    clear_spills(env=env)

@@ -182,6 +182,11 @@ def save_sharded(path: str, state: Any, metadata: Dict[str, Any],
         wait = getattr(ckptr, "wait_until_finished", None)
         if wait is not None:
             wait()
+    # single-writer meta finalize: the array commit above was the
+    # collective (every process wrote its shards); only process 0
+    # digests + renames meta.json, and the multi-host redo path refuses
+    # loudly instead of re-entering the collective save alone
+    # graftlint: ok(rank-divergence) — single-writer meta.json finalize
     if jax.process_index() == 0:
         # the dir can transiently vanish between the array commit and this
         # write (observed rarely when a prior async save's eviction race

@@ -2,12 +2,18 @@
 
 Usage::
 
-    python scripts/graftlint.py [PATH ...] [--verbose]
+    python scripts/graftlint.py [PATH ...] [--verbose] [--format json]
 
 Defaults to the ``ray_lightning_accelerators_tpu`` package next to this
 script.  ``--verbose`` also prints pragma-suppressed findings (the
-deliberate, documented violations).  Wired into ``format.sh`` and run
-as a tier-1 test (``pytest -m analysis``).
+deliberate, documented violations).  ``--format json`` prints ONE
+machine-readable object (schema 1: every finding with rule/path/line/
+col/message/suppressed, plus active/suppressed counts and the exit
+code) — the shape CI and ``scripts/sharding_audit.py`` consume.  Wired
+into ``format.sh`` and run as a tier-1 test (``pytest -m analysis``).
+
+Repeated runs in one process (multiple PATH targets, the audit script)
+reuse the mtime-keyed per-module AST parse cache in ``analysis.lint``.
 
 Import note: only ``analysis.lint`` is loaded (stdlib-only AST work) —
 linting never initializes a jax backend, so this is safe on a machine
@@ -38,13 +44,41 @@ def _load_lint():
 
 
 def main(argv) -> int:
+    import json
+
     lint = _load_lint()
 
     verbose = "--verbose" in argv
-    paths = [a for a in argv if not a.startswith("--")]
+    fmt = "human"
+    args = list(argv)
+    if "--format" in args:
+        i = args.index("--format")
+        if i + 1 >= len(args) or args[i + 1] not in ("human", "json"):
+            print("graftlint: --format takes 'human' or 'json'",
+                  file=sys.stderr)
+            return 2
+        fmt = args[i + 1]
+        del args[i:i + 2]
+    paths = [a for a in args if not a.startswith("--")]
     if not paths:
         paths = [PACKAGE]
     rc = 0
+    if fmt == "json":
+        merged = None
+        for path in paths:
+            payload = lint.report_json(lint.lint_path(path), target=path)
+            if merged is None:
+                merged = payload
+            else:  # multi-target: one object, findings concatenated
+                merged["findings"] += payload["findings"]
+                merged["active"] += payload["active"]
+                merged["suppressed"] += payload["suppressed"]
+                merged["target"] = None
+            rc = max(rc, payload["exit_code"])
+        merged = merged or lint.report_json([])
+        merged["exit_code"] = rc
+        print(json.dumps(merged, indent=2, sort_keys=True))
+        return rc
     for path in paths:
         findings = lint.lint_path(path)
         text, code = lint.report(findings, verbose=verbose)

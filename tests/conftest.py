@@ -53,6 +53,25 @@ def compile_guard():
 
 
 @pytest.fixture
+def spmd_sanitizer(tmp_path, monkeypatch):
+    """Opt-in SPMD collective sanitizer (testing/spmd_sanitizer.py) for
+    THIS process: sets the knob + a telemetry dir, installs the jax.lax
+    interception, yields the module (sanitizer at ``get_sanitizer()``),
+    and uninstalls afterwards so later tests trace unwrapped
+    collectives.  Fan-out tests instead put RLA_TPU_SPMD_SANITIZER in
+    env_per_worker — worker boot installs it rank-keyed."""
+    from ray_lightning_accelerators_tpu.testing import spmd_sanitizer as S
+    tdir = tmp_path / "spmd_telemetry"
+    monkeypatch.setenv("RLA_TPU_SPMD_SANITIZER", "1")
+    monkeypatch.setenv("RLA_TPU_TELEMETRY_DIR", str(tdir))
+    S.install(rank=None)
+    try:
+        yield S
+    finally:
+        S.uninstall()
+
+
+@pytest.fixture
 def cpu_mesh_subprocess():
     """Run a python script in a SPAWNED subprocess whose backend comes up
     with an 8-device virtual CPU mesh.
