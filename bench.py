@@ -719,6 +719,17 @@ def bench_mfu_overlap() -> dict:
     return _run_cpu_probe("mfu_overlap_probe.py", "mfu_overlap")
 
 
+def bench_live_plane() -> dict:
+    """Live-telemetry-plane bench (telemetry/live.py + serve/slo.py):
+    a training fit scraped at ~20Hz through the live /metrics+/statusz
+    endpoints (every scrape exposition-validated; overhead A/B'd), a
+    serve SLO burn-rate contrast (overloaded nonzero, light zero, typed
+    deadline sheds), and a 2-worker ClusterView rank-labeled merge —
+    on a forced-host-platform 8-device CPU mesh (see
+    ``_run_cpu_probe``)."""
+    return _run_cpu_probe("live_plane_probe.py", "live_plane")
+
+
 def bench_perf_observatory() -> dict:
     """Perf-observatory bench (telemetry/perf.py): one 8-dev CPU-mesh
     training run whose per-step phase timeline, HBM pool ledger and
@@ -735,7 +746,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "fsdp_exchange": bench_fsdp_exchange,
            "paged_serve": bench_paged_serve,
            "mfu_overlap": bench_mfu_overlap,
-           "perf_observatory": bench_perf_observatory}
+           "perf_observatory": bench_perf_observatory,
+           "live_plane": bench_live_plane}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -760,7 +772,7 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 # so they double as the probe-failure fallback set
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
-                         "perf_observatory")
+                         "perf_observatory", "live_plane")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -863,7 +875,8 @@ def main() -> None:
     parser.add_argument(
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
-                "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory",
+                "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory,"
+                "live_plane",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--gate", action="store_true",
                         help="run no benches: gate a bench window "

@@ -121,6 +121,10 @@ _register(Knob("RLA_TPU_GLOBAL_SEED", "int", None,
 _register(Knob("RLA_TPU_INSIDE_WORKER", "bool", False,
                "set in spawned workers so nested code never re-launches "
                "a world (core/trainer.py, runtime)"))
+_register(Knob("RLA_TPU_LIVE_REFRESH_S", "float", 2.0,
+               "driver ClusterView refresh cadence in seconds — how "
+               "often every rank's live /snapshot is re-collected "
+               "(telemetry/live.py)"))
 _register(Knob("RLA_TPU_LOG_JSON", "bool", False,
                "structured-JSON log lines (one object per line with "
                "ts/level/rank/pid/msg) instead of the human formatter "
@@ -128,6 +132,13 @@ _register(Knob("RLA_TPU_LOG_JSON", "bool", False,
 _register(Knob("RLA_TPU_LOG_LEVEL", "str", "WARNING",
                "package logger level; unknown names warn and default "
                "(utils/logging.py)"))
+_register(Knob("RLA_TPU_METRICS_PORT", "int", None,
+               "enable the live telemetry plane: port for the per-"
+               "process /metrics + /statusz + /healthz HTTP server "
+               "(loopback-bound; 0 = ephemeral — workers always bind "
+               "ephemeral and publish the port via a portfile under "
+               "RLA_TPU_TELEMETRY_DIR); unset = no server "
+               "(telemetry/live.py)"))
 _register(Knob("RLA_TPU_PERF_HBM_SAMPLE_S", "float", 2.0,
                "minimum seconds between HBM-ledger pool samples; the "
                "per-step seam is a no-op inside the window "
@@ -147,6 +158,23 @@ _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
 _register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
                "preemption grace budget in seconds; setting it installs "
                "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_SLO_DEADLINE_S", "float", None,
+               "serve SLO: end-to-end deadline stamped on each request "
+               "at admission; expired requests are shed typed "
+               "(DeadlineExceeded) before prefill (serve/slo.py)"))
+_register(Knob("RLA_TPU_SLO_TARGET", "float", 0.99,
+               "serve SLO target fraction (e.g. 0.99 = '99% of "
+               "requests'); burn rate divides the observed violation "
+               "fraction by 1 - target (serve/slo.py)"))
+_register(Knob("RLA_TPU_SLO_TOKEN_CADENCE_S", "float", None,
+               "serve SLO: per-token inter-arrival target; decode gaps "
+               "above it count as violations (serve/slo.py)"))
+_register(Knob("RLA_TPU_SLO_TTFT_S", "float", None,
+               "serve SLO: time-to-first-token target; prefills landing "
+               "above it count as violations (serve/slo.py)"))
+_register(Knob("RLA_TPU_SLO_WINDOW_S", "float", 60.0,
+               "rolling window for serve SLO burn-rate accounting "
+               "(serve/slo.py)"))
 _register(Knob("RLA_TPU_SPMD_SANITIZER", "bool", False,
                "opt-in cross-rank collective sanitizer: each process "
                "records its traced collective call sequence and the "

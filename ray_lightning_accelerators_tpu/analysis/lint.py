@@ -424,6 +424,15 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     "telemetry/perf.py": ("StepTimeline.step_end",
                           "StepTimeline.observe",
                           "HbmLedger.maybe_sample", "HbmLedger.sample"),
+    # the live plane's scrape handlers run concurrently with every hot
+    # loop they observe: a handler (or a ClusterView sweep) that
+    # host-synced or built a jit would inject that cost into the run
+    # it is supposed to watch
+    "telemetry/live.py": ("LiveHandler.do_GET", "ClusterView.refresh"),
+    # the SLO tracker's observers run per prefill/token inside the
+    # serve driver loop — host scalars and one deque append only
+    "serve/slo.py": ("SloTracker.observe_ttft", "SloTracker.observe_token",
+                     "SloTracker.shed"),
     # the compressed-FSDP exchange + param gathers are compiled INTO the
     # train step: their builders (and shard_map bodies) must stay
     # host-sync-free and build no jits in loops.  The scan-gather pair

@@ -43,6 +43,7 @@ from ..analysis import knobs
 from ..data import prefetch as prefetch_lib
 from ..data.loader import DataLoader
 from ..parallel import mesh as mesh_lib
+from ..telemetry import live as live_lib
 from ..telemetry import recorder as telemetry
 from ..utils import checkpoint as ckpt_lib
 from ..utils.logging import CSVLogger, InMemoryLogger, Logger, log
@@ -254,6 +255,13 @@ class Trainer:
         # build_metrics_registry() to merge
         self.trace_id: Optional[str] = None
         self._rank_telemetry: Dict[Any, Optional[Dict[str, Any]]] = {}
+        # live telemetry plane (telemetry/live.py): the per-process
+        # /metrics+/statusz+/healthz server (started at fit when
+        # RLA_TPU_METRICS_PORT is configured) and the driver-side
+        # ClusterView aggregating every fan-out rank's live snapshot —
+        # its last view is embedded in run_report.json on failure
+        self._live_server = None
+        self._cluster_view = None
         # preemption drain (runtime/preemption.py): bound at fit start
         # when RLA_TPU_PREEMPT_GRACE_S is configured (None otherwise —
         # zero per-step overhead); the step loop polls it and drains into
@@ -294,6 +302,11 @@ class Trainer:
         state = dict(self.__dict__)
         state["_world"] = None
         state["_preempt_notice"] = None
+        # the live server/cluster view hold sockets + threads; workers
+        # start their own at boot (actors._worker_main) and bind their
+        # copy of the trainer to it at fit
+        state["_live_server"] = None
+        state["_cluster_view"] = None
         return state
 
     # ------------------------------------------------------------------ #
@@ -1566,18 +1579,48 @@ class Trainer:
         # so every worker's events and the driver's share one id
         telemetry.emit("fit_start", fanout=n)
         world = self._acquire_world(spec)
+        # live telemetry plane: driver server + ClusterView over the
+        # fan-out ranks — each worker's live /snapshot (portfile scrape
+        # locally, the agent `live` wire op remotely) merges rank-
+        # labeled into the driver's /metrics while the fit runs, and
+        # the last collected view is embedded in run_report.json if
+        # the run dies
+        self._live_server = live_lib.maybe_start_from_env()
+        if self._live_server is not None:
+            self._live_server.sources.bind_trainer(self)
+            self._cluster_view = live_lib.ClusterView(
+                workers=list(world.pool.workers)).start()
+            self._live_server.sources.bind_cluster_view(
+                self._cluster_view)
         self._strip_for_shipment(module)
 
         queue = TrampolineQueue()
         # datasets ship ONCE per world (content-addressed worker cache);
         # a later test/predict/refit over the same data sends a key, not
         # the bytes
-        body = functools.partial(_remote_fit_worker, self, module,
-                                 world.ship_value(train_dataloaders),
-                                 world.ship_value(val_dataloaders),
-                                 world.ship_value(datamodule), ckpt_path)
-        results = self._run_in_world(world, module, body, queue,
-                                     stage="fit")
+        try:
+            body = functools.partial(
+                _remote_fit_worker, self, module,
+                world.ship_value(train_dataloaders),
+                world.ship_value(val_dataloaders),
+                world.ship_value(datamodule), ckpt_path)
+            results = self._run_in_world(world, module, body, queue,
+                                         stage="fit")
+            if self._cluster_view is not None:
+                # one deliberate final sweep while the world is still
+                # up: a fit shorter than the refresh cadence must not
+                # finish with an empty view (failure paths skip this —
+                # the pool is already gone, and the periodic thread's
+                # last successful view is exactly what we keep)
+                try:
+                    self._cluster_view.refresh()
+                except Exception:
+                    pass
+        finally:
+            # stop the refresh thread; the LAST collected view stays on
+            # self._cluster_view for the failure report / later scrapes
+            if self._cluster_view is not None:
+                self._cluster_view.stop()
 
         # per-rank telemetry (profiler exports + event tails) shipped
         # home by every rank — build_metrics_registry merges them
@@ -1709,14 +1752,23 @@ class Trainer:
             return  # _run_in_world already wrote this failure's report
         try:
             from ..telemetry import registry as treg
+            extra: Dict[str, Any] = {"global_step": self.global_step,
+                                     "epoch": self.current_epoch}
+            if self._cluster_view is not None:
+                # the last LIVE view collected before death: per-rank
+                # health/step/serve rows the spill files don't carry
+                try:
+                    extra["cluster_view"] = \
+                        self._cluster_view.last_view()
+                except Exception:
+                    pass
             treg.write_run_report(
                 os.path.join(self.default_root_dir, "run_report.json"),
                 error=exc, trace_id=self.trace_id,
                 rank_events=treg.gather_spill_dir(),
                 stall_diagnosis=self.last_stall_diagnosis,
                 registry=self.build_metrics_registry(),
-                extra={"global_step": self.global_step,
-                       "epoch": self.current_epoch})
+                extra=extra)
             try:
                 exc._rla_report_written = True
             except Exception:
@@ -1760,6 +1812,17 @@ class Trainer:
             # perf-observatory ledgers (telemetry/perf.py): step
             # timeline + HBM pools (+ goodput when one was fed)
             self.perf.register(reg)
+        if self._cluster_view is not None:
+            # live per-rank view (telemetry/live.py): rank-labeled
+            # health/step rows always; mergeable data only for ranks
+            # whose final telemetry did NOT already ship home above
+            try:
+                self._cluster_view.merge_into(
+                    reg, skip_mergeables=[
+                        k for k, v in self._rank_telemetry.items()
+                        if v])
+            except Exception as e:
+                log.warning("cluster-view merge failed: %s", e)
         return reg
 
     def _fit_local(self, module: TpuModule,
@@ -1792,6 +1855,14 @@ class Trainer:
         self.accelerator.setup_environment()
         self._mesh = self.accelerator.build_mesh()
         self._bind_preemption()
+        # live telemetry plane: the per-process server starts once (when
+        # RLA_TPU_METRICS_PORT is configured — on workers it was already
+        # started at boot) and this fit's trainer becomes its live
+        # source, so /metrics answers with the run's CURRENT registry
+        # while steps are still running
+        self._live_server = live_lib.maybe_start_from_env()
+        if self._live_server is not None:
+            self._live_server.sources.bind_trainer(self)
         telemetry.emit("fit_start", step=self.global_step,
                        processes=jax.process_count())
 

@@ -62,6 +62,20 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
         telemetry.configure(rank=rank, env=env)
     except Exception:
         telemetry = None
+    # live telemetry plane (telemetry/live.py): with RLA_TPU_METRICS_PORT
+    # in the overlay this rank serves /metrics + /statusz + /healthz on
+    # an ephemeral loopback port published via its portfile — /healthz
+    # classifies from THIS rank's own heartbeat channel, so a hung
+    # dispatch flips it to wedged before the driver watchdog reaps.
+    # Observes, never gates: a bind failure leaves the worker running.
+    try:
+        from ..telemetry import live as live_telemetry
+        live_telemetry.maybe_start_from_env(
+            rank=rank, env=env,
+            beat_snapshot_fn=(heartbeat.snapshot
+                              if heartbeat is not None else None))
+    except Exception:
+        pass
     # opt-in SPMD collective sanitizer (testing/spmd_sanitizer.py):
     # when RLA_TPU_SPMD_SANITIZER is in the overlay, every collective
     # this worker traces is recorded + spilled rank-keyed so the driver
@@ -365,6 +379,18 @@ class Worker:
         from ..telemetry.recorder import read_spill, spill_path_for
         path = spill_path_for(self.rank, env=self._env)
         return read_spill(path) if path else None
+
+    def live_snapshot(self) -> Optional[Dict[str, Any]]:
+        """This rank's LIVE telemetry snapshot (telemetry/live.py),
+        scraped from its portfile-published loopback endpoint — the
+        ClusterView seam.  None when the live plane is disabled, the
+        rank never bound, or it stopped answering (a wedged rank's
+        last snapshot survives in the ClusterView's view, not here)."""
+        from ..telemetry.live import scrape_rank
+        try:
+            return scrape_rank(self.rank, env=self._env)
+        except Exception:
+            return None
 
     # parity surface (reference: ray_ddp.py:21-27)
     def set_env_var(self, key: str, value: str) -> Future:

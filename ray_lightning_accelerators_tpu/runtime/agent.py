@@ -255,6 +255,14 @@ class HostAgent:
                         reply(req_id, "ok",
                               None if worker is None
                               else worker.telemetry_tail())
+                    elif op == "live":
+                        # the worker's live telemetry /snapshot, scraped
+                        # agent-side (the loopback endpoint + portfile
+                        # live on THIS host) — the ClusterView's remote
+                        # seam, mirroring the `telemetry` spill op
+                        reply(req_id, "ok",
+                              None if worker is None
+                              else worker.live_snapshot())
                     elif op == "reap":
                         if worker is not None:
                             worker.reap(payload)
@@ -474,6 +482,16 @@ class RemoteWorker:
         any failure — telemetry degrades, never blocks supervision."""
         try:
             return self._conn.call("telemetry", timeout=10)
+        except BaseException:
+            return None
+
+    def live_snapshot(self) -> Optional[Dict]:
+        """This rank's live telemetry /snapshot, scraped on the remote
+        host through the agent (``live`` wire op — the portfile and
+        loopback endpoint live there).  None on any failure: the
+        ClusterView keeps the last successful view instead."""
+        try:
+            return self._conn.call("live", timeout=10)
         except BaseException:
             return None
 

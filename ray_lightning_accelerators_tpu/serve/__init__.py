@@ -30,10 +30,12 @@ from .batcher import (AdmissionController, PoolExhausted, QueueFull,
 from .engine import BlockAllocator, ServeEngine
 from .metrics import ServeMetrics
 from .replicas import ServeReplicas
+from .slo import DeadlineExceeded, SloPolicy, SloTracker
 
 __all__ = [
     "AdmissionController", "PoolExhausted", "QueueFull",
     "RequestRejected", "ServeCancelled", "ServeRequest", "ServeResponse",
     "BlockAllocator", "ServeEngine", "ServeMetrics", "ServeReplicas",
     "blocks_for_request",
+    "SloPolicy", "SloTracker", "DeadlineExceeded",
 ]

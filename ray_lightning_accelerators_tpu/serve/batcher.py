@@ -103,6 +103,12 @@ class ServeRequest:
     max_new_tokens: int
     t_submit: float             # monotonic, stamped at admission
     requeues: int = 0           # infra-failure re-admissions so far
+    # absolute SLO deadline (monotonic; serve/slo.py), stamped ONCE at
+    # admission when the controller carries a policy with deadline_s.
+    # It rides the request object through requeue and replica
+    # re-dispatch, so an infra retry never resets the client's clock;
+    # the engine sheds expired requests typed BEFORE prefill
+    deadline: Optional[float] = None
     # per-request trace id (telemetry/recorder.py): stamped at admission
     # so every flight-recorder event of this request's lifecycle
     # (admit -> prefill -> decode -> respond) correlates — across
@@ -183,7 +189,8 @@ class AdmissionController:
                  max_blocks_per_slot: Optional[int] = None,
                  spec_headroom: int = 0,
                  pool_overcommit: float = 1.0,
-                 hard_total_cap: Optional[int] = None):
+                 hard_total_cap: Optional[int] = None,
+                 slo_policy: Any = None):
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
         if block_len is not None and (pool_blocks is None
@@ -204,6 +211,9 @@ class AdmissionController:
         # and generate() refuses them, so the exactness contract
         # requires the engine to refuse them too
         self.hard_total_cap = hard_total_cap
+        # serve/slo.py SloPolicy: admission stamps each request's
+        # absolute deadline from it (None = no SLO attached)
+        self.slo_policy = slo_policy
         self._q = TrampolineQueue()
         self._requeue: deque = deque()
         self._cond = threading.Condition()
@@ -284,6 +294,9 @@ class AdmissionController:
                                trace_id=mint_trace_id(),
                                speculative=bool(speculative),
                                blocks_reserved=needed)
+            if self.slo_policy is not None \
+                    and self.slo_policy.deadline_s is not None:
+                req.deadline = req.t_submit + self.slo_policy.deadline_s
             self._outstanding_blocks += needed
             resp = ServeResponse(req)
             self._q.put((req, resp))

@@ -62,6 +62,7 @@ uses it as the placed-bytes baseline the paged pool is judged against.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
@@ -74,6 +75,9 @@ from ..utils.logging import log
 from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
                       ServeResponse, blocks_for_request)
 from .metrics import ServeMetrics
+
+# live-plane labels for engines sharing one process (telemetry/live.py)
+_ENGINE_SEQ = itertools.count()
 
 
 class BlockAllocator:
@@ -241,7 +245,8 @@ class ServeEngine:
                  pool_overcommit: float = 1.0,
                  draft_model: Any = None,
                  draft_params: Any = None,
-                 spec_k: int = 4):
+                 spec_k: int = 4,
+                 slo: Any = "env"):
         import jax
 
         if model.cfg.sliding_window is not None:
@@ -277,6 +282,27 @@ class ServeEngine:
         # warning per call site -- skip it there to keep test logs quiet.
         donate = jax.default_backend() != "cpu"
         self._donate = donate
+
+        # -- SLO engine (serve/slo.py) --------------------------------- #
+        # slo: an SloPolicy, None (disabled), or "env" (default — built
+        # from the SLO knobs, see analysis/knobs.py; no knob set = no tracker, zero
+        # per-request overhead).  With a policy attached: admission
+        # stamps each request's absolute deadline, expired requests are
+        # shed typed BEFORE prefill, TTFT/token-cadence observations
+        # feed the rolling burn-rate window, and the slo_burn_rate /
+        # slo_violations_total signals ride every metrics snapshot.
+        from .slo import SloPolicy, SloTracker
+        if slo == "env":
+            slo = SloPolicy.from_env()
+        if slo is not None and not isinstance(slo, SloPolicy):
+            raise ValueError(
+                "slo must be an SloPolicy, None, or 'env'; got "
+                f"{type(slo).__name__}")
+        self.slo_policy = slo if slo is not None and slo.enabled else None
+        self._slo = (SloTracker(self.slo_policy, self.metrics)
+                     if self.slo_policy is not None else None)
+        if self._slo is not None:
+            self.metrics.bind_slo(self._slo.gauges)
 
         # -- speculative lane ------------------------------------------ #
         self.draft_model = draft_model
@@ -342,7 +368,8 @@ class ServeEngine:
                 max_blocks_per_slot=self.max_blocks_per_slot,
                 spec_headroom=headroom,
                 pool_overcommit=pool_overcommit,
-                hard_total_cap=model.cfg.max_seq_len)
+                hard_total_cap=model.cfg.max_seq_len,
+                slo_policy=self.slo_policy)
             self._tables = np.zeros(
                 (max_slots, self.max_blocks_per_slot), np.int32)
             self.metrics.bind_pool(self._pool_gauges)
@@ -361,7 +388,8 @@ class ServeEngine:
             self.prompt_block = max(1, prompt_block)
             self.batcher = AdmissionController(
                 queue_depth=queue_depth, max_total_len=W,
-                max_new_tokens_cap=max_new_tokens_cap)
+                max_new_tokens_cap=max_new_tokens_cap,
+                slo_policy=self.slo_policy)
             self._join = jax.jit(type(model).cache_join,
                                  donate_argnums=(0,) if donate else ())
 
@@ -381,6 +409,7 @@ class ServeEngine:
         self._stop = threading.Event()
         self._cancel_active = False
         self._thread: Optional[threading.Thread] = None
+        self._live_label: Optional[str] = None
         # mesh mutation LAST, after every validation that can raise: a
         # failed construction must not hand the caller back a model
         # silently stripped of its training mesh.  Decode runs
@@ -411,6 +440,16 @@ class ServeEngine:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="rla-tpu-serve-engine")
         self._thread.start()
+        # live telemetry plane (telemetry/live.py): when
+        # RLA_TPU_METRICS_PORT is configured, this engine's live
+        # ServeMetrics (+ SLO burn rate) become scrapeable on the
+        # process's /metrics and /statusz while it serves
+        from ..telemetry import live as live_lib
+        srv = live_lib.maybe_start_from_env()
+        if srv is not None:
+            self._live_label = f"engine{next(_ENGINE_SEQ)}"
+            srv.sources.add_serve(self._live_label, self.metrics,
+                                  slo=self._slo)
         return self
 
     def stop(self, cancel_active: bool = False,
@@ -428,6 +467,12 @@ class ServeEngine:
         n = self.batcher.shutdown()
         if n:
             self.metrics.inc("cancelled", n)
+        if self._live_label is not None:
+            from ..telemetry import live as live_lib
+            srv = live_lib.get_server()
+            if srv is not None:
+                srv.sources.remove_serve(self._live_label)
+            self._live_label = None
         self.model.mesh = self._mesh_saved
         if self.draft_model is not None:
             self.draft_model.mesh = self._draft_mesh_saved
@@ -696,6 +741,34 @@ class ServeEngine:
             self.allocator.register(keys[j], blocks[j])
 
     # -- admission ------------------------------------------------------ #
+    def _pop_admittable(self) -> Optional[Tuple[ServeRequest,
+                                                ServeResponse]]:
+        """Next queued request still worth serving.  With an SLO policy
+        attached, a request whose deadline passed while it queued is
+        shed typed (``DeadlineExceeded``) RIGHT HERE — before any
+        prefill compute is spent on a response the client already
+        abandoned — its admission block reservation returns to the
+        budget, and the pop retries the next request."""
+        while True:
+            item = self.batcher.pop()
+            if item is None:
+                return None
+            req, resp = item
+            if self._slo is not None and req.deadline is not None \
+                    and time.monotonic() > req.deadline:
+                exc = self._slo.shed(req,
+                                     time.monotonic() - req.t_submit)
+                if resp._fail(exc):
+                    self.metrics.inc("failed")
+                self.batcher.release_blocks(req)
+                continue
+            # NOTE: the deadline-MET observation is recorded at prefill
+            # (the one-per-request point), not here — a pool-full head
+            # request is re-popped via push_front every loop iteration,
+            # and per-pop observations would flood the window with
+            # non-violations exactly when overload matters
+            return item
+
     def _admit(self) -> int:
         """Fill free slots from the queue: prefill each request into its
         cache (dense row-join or paged blocks), record TTFT (the first
@@ -705,7 +778,7 @@ class ServeEngine:
         for i in range(self.max_slots):
             if self._slots[i] is not None:
                 continue
-            item = self.batcher.pop()
+            item = self._pop_admittable()
             if item is None:
                 break
             req, resp = item
@@ -772,6 +845,9 @@ class ServeEngine:
         now = time.monotonic()
         resp.ttft_s = now - req.t_submit
         self.metrics.observe_ttft(resp.ttft_s)
+        if self._slo is not None:
+            self._slo.observe_ttft(resp.ttft_s, req)
+            self._slo.observe_deadline_met(req)
         self.metrics.observe_prefill(now - t_a)
         if self.perf_timeline is not None:
             self.perf_timeline.observe("prefill", now - t_a)
@@ -809,6 +885,9 @@ class ServeEngine:
             now = time.monotonic()
             resp.ttft_s = now - req.t_submit
             self.metrics.observe_ttft(resp.ttft_s)
+            if self._slo is not None:
+                self._slo.observe_ttft(resp.ttft_s, req)
+                self._slo.observe_deadline_met(req)
             self.metrics.observe_prefill(now - t_a)
             if self.perf_timeline is not None:
                 self.perf_timeline.observe("prefill", now - t_a)
@@ -871,7 +950,10 @@ class ServeEngine:
             s.pos += 1
             s.last = tok
             s.remaining -= 1
-            self.metrics.observe_token_latency(now - s.t_last)
+            gap = now - s.t_last
+            self.metrics.observe_token_latency(gap)
+            if self._slo is not None:
+                self._slo.observe_token(gap, s.req)
             s.t_last = now
             if s.remaining <= 0:
                 self._finish(s.req, s.resp, s.generated)
@@ -985,6 +1067,8 @@ class ServeEngine:
                 dt_tok = (now - t_last_tok) / max(1, len(new))
                 for _ in new:
                     self.metrics.observe_token_latency(dt_tok)
+                    if self._slo is not None:
+                        self._slo.observe_token(dt_tok, req)
                 t_last_tok = now
                 out.extend(new)
         self._finish(req, resp, out)

@@ -49,7 +49,8 @@ class ServeMetrics:
                  "steps", "steps_batch_gt1", "wedge_events",
                  "pool_exhausted", "prefix_lookups", "prefix_hits",
                  "prefix_hit_blocks", "speculative_requests",
-                 "speculative_rounds", "speculative_tokens_accepted")
+                 "speculative_rounds", "speculative_tokens_accepted",
+                 "slo_violations", "slo_deadline_shed")
 
     # pool/HBM fields are GAUGES (live values, not monotone counters);
     # telemetry/registry.py keys its Prometheus type choice off this set
@@ -59,6 +60,10 @@ class ServeMetrics:
                    "hbm_cache_bytes", "hbm_used_bytes",
                    "dense_equivalent_bytes", "cache_waste_ratio",
                    "peak_used_blocks", "peak_concurrent")
+
+    # SLO fields (serve/slo.py SloTracker.gauges) are gauges too: the
+    # burn rate is a live level an autoscaler reads, never a counter
+    SLO_GAUGES = ("slo_burn_rate", "slo_window_observations")
 
     def __init__(self, profiler: Optional[Profiler] = None):
         self.profiler = profiler or Profiler()
@@ -71,6 +76,7 @@ class ServeMetrics:
         self._t_last: Optional[float] = None
         self._queue_depth: Callable[[], int] = lambda: 0
         self._pool_gauges: Optional[Callable[[], Dict[str, Any]]] = None
+        self._slo_gauges: Optional[Callable[[], Dict[str, Any]]] = None
 
     # ------------------------------------------------------------------ #
     def bind_queue(self, depth_fn: Callable[[], int]) -> None:
@@ -85,6 +91,13 @@ class ServeMetrics:
         engines never bind, and the fields stay absent."""
         self._pool_gauges = gauges_fn
 
+    def bind_slo(self, gauges_fn: Callable[[], Dict[str, Any]]) -> None:
+        """Wire the live SLO gauges (serve/slo.py
+        ``SloTracker.gauges``): ``slo_burn_rate`` + window size merged
+        into every snapshot.  Engines without an SLO policy never bind,
+        and the fields stay absent."""
+        self._slo_gauges = gauges_fn
+
     def observe_pool(self, used_blocks: int, concurrent: int) -> None:
         """Record a pool-occupancy observation (engine calls at every
         admit/retire): high-watermarks survive in the snapshot so probes
@@ -95,28 +108,38 @@ class ServeMetrics:
                                          used_blocks)
             self._peak_concurrent = max(self._peak_concurrent, concurrent)
 
+    # Lock discipline (live-scrape consistency): every observe_* holds
+    # self._lock around BOTH its reservoir write (profiler.observe) and
+    # its counter/busy-window updates, and snapshot() reads the
+    # profiler summary under the SAME lock — so a concurrent scrape can
+    # never see a reservoir that advanced without its counter (or vice
+    # versa).  Ordering is always ServeMetrics._lock -> Profiler._lock,
+    # never the reverse, so the nesting cannot deadlock.
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._c[name] = self._c.get(name, 0) + n
 
     def observe_ttft(self, dt_s: float) -> None:
-        self.profiler.observe(self.TTFT, dt_s)
+        with self._lock:
+            self.profiler.observe(self.TTFT, dt_s)
 
     def observe_queue_wait(self, dt_s: float) -> None:
         """Admission -> slot-join wait (recorded the moment the engine
         starts the request's prefill)."""
-        self.profiler.observe(self.QUEUE, dt_s)
+        with self._lock:
+            self.profiler.observe(self.QUEUE, dt_s)
 
     def observe_token_latency(self, dt_s: float) -> None:
-        self.profiler.observe(self.TOKEN, dt_s)
+        with self._lock:
+            self.profiler.observe(self.TOKEN, dt_s)
 
     def observe_prefill(self, dt_s: float) -> None:
         """One admission prefill.  Counts the request's FIRST served token
         (prefill produces it) and extends the busy window, so
         throughput/tokens stay honest even for max_new_tokens=1 loads."""
-        self.profiler.observe(self.PREFILL, dt_s)
         now = time.monotonic()
         with self._lock:
+            self.profiler.observe(self.PREFILL, dt_s)
             self._c["prefills"] += 1
             self._c["tokens_generated"] += 1
             if self._t_first is None:
@@ -128,9 +151,9 @@ class ServeMetrics:
         accepted+corrected tokens in one target pass: extends the busy
         window and the token count (throughput stays honest), counted
         under ``speculative_rounds`` rather than ``steps``."""
-        self.profiler.observe(self.STEP, dt_s)
         now = time.monotonic()
         with self._lock:
+            self.profiler.observe(self.STEP, dt_s)
             self._c["speculative_rounds"] += 1
             self._c["tokens_generated"] += tokens
             if self._t_first is None:
@@ -141,9 +164,9 @@ class ServeMetrics:
         """One continuous-batching decode step over ``active`` live slots
         (inactive slots ride along at static shape; they are compute, not
         service)."""
-        self.profiler.observe(self.STEP, dt_s)
         now = time.monotonic()
         with self._lock:
+            self.profiler.observe(self.STEP, dt_s)
             self._c["steps"] += 1
             if active > 1:
                 self._c["steps_batch_gt1"] += 1
@@ -159,8 +182,12 @@ class ServeMetrics:
 
         ``throughput_tok_s`` divides generated tokens by the busy window
         (first step start -> last step end), not process lifetime — an
-        idle engine must not look slow."""
-        s = self.profiler.summary()
+        idle engine must not look slow.
+
+        The whole read happens under the metrics lock (see the lock
+        discipline note above the observers): a live ``/metrics`` scrape
+        racing concurrent ``observe_*`` calls gets ONE consistent view —
+        reservoir counts and their paired counters can never tear."""
 
         def pct(name: str) -> Optional[Dict[str, float]]:
             row = s.get(name)
@@ -170,6 +197,7 @@ class ServeMetrics:
                                         "p95_s", "p99_s", "max_s")}
 
         with self._lock:
+            s = self.profiler.summary()
             counters = dict(self._c)
             max_batch = self._max_batch
             peak_used = self._peak_used_blocks
@@ -185,6 +213,8 @@ class ServeMetrics:
             out.update(self._pool_gauges())
             out["peak_used_blocks"] = peak_used
             out["peak_concurrent"] = peak_conc
+        if self._slo_gauges is not None:
+            out.update(self._slo_gauges())
         out["throughput_tok_s"] = (
             counters["tokens_generated"] / busy_s if busy_s > 0 else 0.0)
         out["ttft_s"] = pct(self.TTFT)
@@ -209,7 +239,7 @@ class ServeMetrics:
             self._peak_concurrent = 0
             self._t_first = None
             self._t_last = None
-        self.profiler.reset()
+            self.profiler.reset()
 
     def describe(self) -> str:
         """Human-readable snapshot + the profiler's latency table."""

@@ -1,0 +1,274 @@
+"""Serve SLO engine: targets attached at admission, live burn rate out.
+
+A serving tier scales and sheds on SERVICE-LEVEL objectives, not raw
+latency reservoirs: "99% of requests see first token within X ms and a
+token cadence within Y ms" is the contract an autoscaler can act on
+(ROADMAP item 3 drives replica count and admission from exactly these
+signals).  This module adds the three pieces the metrics layer was
+missing:
+
+- :class:`SloPolicy` — the declared targets (``ttft_target_s``,
+  ``token_cadence_target_s``, ``deadline_s``, ``target_fraction``),
+  attached to every request at admission (``AdmissionController``
+  stamps the absolute deadline on the ``ServeRequest``, so it
+  propagates through requeue and replica re-dispatch untouched —
+  an infra retry never resets a client's clock);
+- **deadline shed**: a request whose deadline passed while it queued is
+  failed typed (:class:`DeadlineExceeded`) *before* prefill — spending
+  compute on a response the client already abandoned is the worst way
+  to handle overload.  Sheds are counted (``slo_deadline_shed``) and
+  emit a typed ``slo_violation`` flight-recorder event;
+- :class:`SloTracker` — rolling-window burn-rate accounting over the
+  observations the engine already makes (TTFT at prefill, per-token
+  cadence at decode).  ``burn_rate`` = observed violation fraction /
+  allowed violation fraction (``1 - target_fraction``): 1.0 means the
+  error budget is being consumed exactly at the sustainable rate,
+  >1 means the SLO is burning down — the scale-up/admission signal.
+  Exported live as the ``slo_burn_rate`` gauge and the
+  ``slo_violations_total`` counter (ServeMetrics snapshot → registry →
+  ``/metrics``).
+
+Hot-path discipline: every observation is one lock + one deque append
+of host scalars (the engine loop calls these per prefill/token); the
+window prunes incrementally, never scans the reservoirs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Mapping, Optional
+
+from ..analysis import knobs
+from ..telemetry import recorder as telemetry
+
+TTFT_ENV = "RLA_TPU_SLO_TTFT_S"
+CADENCE_ENV = "RLA_TPU_SLO_TOKEN_CADENCE_S"
+DEADLINE_ENV = "RLA_TPU_SLO_DEADLINE_S"
+WINDOW_ENV = "RLA_TPU_SLO_WINDOW_S"
+TARGET_ENV = "RLA_TPU_SLO_TARGET"
+
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_TARGET_FRACTION = 0.99
+# bound on the rolling window's observation deque: at sane request
+# rates 60s of observations fit easily; a pathological flood degrades
+# to "the newest N observations", never unbounded memory
+MAX_WINDOW_OBSERVATIONS = 16384
+
+FAMILIES = ("ttft", "token_cadence", "deadline")
+
+
+class DeadlineExceeded(RuntimeError):
+    """Typed load shed: the request's SLO deadline passed while it was
+    still queued, so the engine refused to spend prefill compute on it.
+    Retryable in principle (the 504 analog), but the client's own
+    deadline has passed — resubmission needs a fresh budget."""
+
+    def __init__(self, request_id: int, waited_s: float,
+                 deadline_s: float):
+        super().__init__(
+            f"request {request_id} shed before prefill: queued "
+            f"{waited_s:.3f}s past its {deadline_s:.3f}s SLO deadline")
+        self.request_id = request_id
+        self.waited_s = waited_s
+        self.deadline_s = deadline_s
+
+
+class SloPolicy:
+    """Declared service-level targets for one engine (or replica group).
+
+    Any subset may be set; ``None`` disables that family.  All targets
+    are judged at ``target_fraction`` (default 0.99 — "99% of
+    requests"): the tracker's burn rate divides the observed violation
+    fraction by the ``1 - target_fraction`` error budget."""
+
+    def __init__(self, ttft_target_s: Optional[float] = None,
+                 token_cadence_target_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 target_fraction: float = DEFAULT_TARGET_FRACTION):
+        for name, v in (("ttft_target_s", ttft_target_s),
+                        ("token_cadence_target_s", token_cadence_target_s),
+                        ("deadline_s", deadline_s)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
+        if not (0.0 < target_fraction < 1.0):
+            raise ValueError(
+                f"target_fraction must be in (0, 1), got {target_fraction}")
+        self.ttft_target_s = ttft_target_s
+        self.token_cadence_target_s = token_cadence_target_s
+        self.deadline_s = deadline_s
+        self.target_fraction = target_fraction
+
+    @property
+    def enabled(self) -> bool:
+        return any(v is not None for v in
+                   (self.ttft_target_s, self.token_cadence_target_s,
+                    self.deadline_s))
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None
+                 ) -> Optional["SloPolicy"]:
+        """The knob-configured policy, or None when none of the SLO
+        knobs is set (the zero-overhead default)."""
+        policy = cls(
+            ttft_target_s=knobs.get_float(TTFT_ENV, None, env=env),
+            token_cadence_target_s=knobs.get_float(CADENCE_ENV, None,
+                                                   env=env),
+            deadline_s=knobs.get_float(DEADLINE_ENV, None, env=env),
+            target_fraction=knobs.get_float(TARGET_ENV,
+                                            DEFAULT_TARGET_FRACTION,
+                                            env=env))
+        return policy if policy.enabled else None
+
+    def describe(self) -> Dict[str, Any]:
+        return {"ttft_target_s": self.ttft_target_s,
+                "token_cadence_target_s": self.token_cadence_target_s,
+                "deadline_s": self.deadline_s,
+                "target_fraction": self.target_fraction}
+
+
+class SloTracker:
+    """Rolling-window SLO accounting for one engine.
+
+    The engine reports what it already measures — TTFT at prefill,
+    per-token cadence at decode, deadline sheds at admission pop — and
+    the tracker keeps a bounded ``(ts, violated)`` window per family.
+    ``burn_rate()`` is the max across enabled families (the tier is as
+    unhealthy as its worst objective); per-family rates ride the
+    snapshot for diagnosis."""
+
+    def __init__(self, policy: SloPolicy, metrics: Any = None,
+                 window_s: Optional[float] = None,
+                 env: Optional[Mapping[str, str]] = None):
+        if window_s is None:
+            window_s = knobs.get_float(WINDOW_ENV, DEFAULT_WINDOW_S,
+                                       env=env)
+        self.policy = policy
+        self.window_s = max(0.1, float(window_s))
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._obs: Dict[str, deque] = {
+            f: deque(maxlen=MAX_WINDOW_OBSERVATIONS) for f in FAMILIES}
+
+    # -- engine-side observations --------------------------------------- #
+    def _observe(self, family: str, violated: bool, req: Any = None,
+                 value_s: Optional[float] = None,
+                 target_s: Optional[float] = None) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            dq = self._obs[family]
+            dq.append((now, violated))
+            cutoff = now - self.window_s
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+        if violated:
+            if self.metrics is not None:
+                self.metrics.inc("slo_violations")
+            telemetry.emit(
+                "slo_violation",
+                trace=getattr(req, "trace_id", None),
+                request=getattr(req, "request_id", None),
+                family=family,
+                value_ms=(round(value_s * 1e3, 3)
+                          if value_s is not None else None),
+                target_ms=(round(target_s * 1e3, 3)
+                           if target_s is not None else None))
+        return violated
+
+    def observe_ttft(self, ttft_s: float, req: Any = None) -> bool:
+        """One request's measured TTFT; returns whether it violated."""
+        target = self.policy.ttft_target_s
+        if target is None:
+            return False
+        return self._observe("ttft", ttft_s > target, req,
+                             value_s=ttft_s, target_s=target)
+
+    def observe_token(self, gap_s: float, req: Any = None) -> bool:
+        """One inter-token gap of one request's stream."""
+        target = self.policy.token_cadence_target_s
+        if target is None:
+            return False
+        return self._observe("token_cadence", gap_s > target, req,
+                             value_s=gap_s, target_s=target)
+
+    def observe_deadline_met(self, req: Any = None) -> None:
+        """A request that made it to prefill within its deadline — the
+        non-violation half of the deadline family's window (without it,
+        one shed would read as a 100% violation rate).  Called at the
+        PREFILL seam (once per served request), never at queue pop:
+        a pool-full head request is re-popped every engine-loop
+        iteration, and per-pop observations would drown real sheds in
+        spurious non-violations exactly under the overload the burn
+        rate exists to flag."""
+        if self.policy.deadline_s is not None:
+            self._observe("deadline", False, req)
+
+    def shed(self, req: Any, waited_s: float) -> DeadlineExceeded:
+        """Account one deadline shed and build its typed failure (the
+        engine fails the popped request's future with it)."""
+        if self.metrics is not None:
+            self.metrics.inc("slo_deadline_shed")
+        self._observe("deadline", True, req, value_s=waited_s,
+                      target_s=self.policy.deadline_s)
+        return DeadlineExceeded(getattr(req, "request_id", -1),
+                                waited_s, self.policy.deadline_s or 0.0)
+
+    # -- exports --------------------------------------------------------- #
+    def _family_rates(self) -> Dict[str, Dict[str, Any]]:
+        now = time.monotonic()
+        cutoff = now - self.window_s
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for family, dq in self._obs.items():
+                while dq and dq[0][0] < cutoff:
+                    dq.popleft()
+                n = len(dq)
+                v = sum(1 for _ts, bad in dq if bad)
+                out[family] = {"observations": n, "violations": v,
+                               "violation_fraction":
+                                   round(v / n, 6) if n else 0.0}
+        return out
+
+    def _burn_from(self, rates: Mapping[str, Mapping[str, Any]]) -> float:
+        allowed = 1.0 - self.policy.target_fraction
+        if allowed <= 0:
+            return 0.0
+        enabled = {
+            "ttft": self.policy.ttft_target_s,
+            "token_cadence": self.policy.token_cadence_target_s,
+            "deadline": self.policy.deadline_s,
+        }
+        burn = 0.0
+        for family, target in enabled.items():
+            if target is None:
+                continue
+            burn = max(burn,
+                       rates[family]["violation_fraction"] / allowed)
+        return round(burn, 6)
+
+    def burn_rate(self) -> float:
+        """Observed violation fraction over the allowed fraction
+        (``1 - target_fraction``), maxed across enabled families.
+        0 = clean window; 1 = consuming the error budget exactly;
+        saturates at ``1/allowed`` when every observation violates."""
+        return self._burn_from(self._family_rates())
+
+    def gauges(self) -> Dict[str, float]:
+        """The live gauge set ServeMetrics merges into every snapshot
+        (``bind_slo``) — the exact signals ROADMAP item 3's autoscaler
+        and admission control consume.  One window scan per call: the
+        rates feed both gauges (this runs on every /metrics scrape)."""
+        rates = self._family_rates()
+        return {
+            "slo_burn_rate": self._burn_from(rates),
+            "slo_window_observations": float(sum(
+                r["observations"] for r in rates.values())),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        rates = self._family_rates()
+        return {"policy": self.policy.describe(),
+                "window_s": self.window_s,
+                "burn_rate": self._burn_from(rates),
+                "families": rates}

@@ -15,6 +15,8 @@ See docs/API.md "Telemetry & tracing" / "Perf observatory" for event
 kinds, phase/pool vocabularies, export formats and the report schema.
 """
 
+from .live import (ClusterView, LiveSources, TelemetryServer,
+                   classify_health)
 from .perf import (GOODPUT_CATEGORIES, PHASE_KINDS, GoodputLedger,
                    HbmLedger, PerfObservatory, StepTimeline,
                    exposed_comm_crosscheck, placed_bytes_total,
@@ -37,4 +39,5 @@ __all__ = [
     "PerfObservatory", "StepTimeline", "HbmLedger", "GoodputLedger",
     "PHASE_KINDS", "GOODPUT_CATEGORIES", "exposed_comm_crosscheck",
     "tree_nbytes", "placed_bytes_total",
+    "TelemetryServer", "LiveSources", "ClusterView", "classify_health",
 ]

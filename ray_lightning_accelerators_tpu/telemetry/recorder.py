@@ -94,6 +94,11 @@ EVENT_KINDS = frozenset({
     "elastic_preempt_resume", "elastic_shrink",
     # serve lifecycle (serve/engine.py)
     "serve_admit", "serve_prefill", "serve_decode_step", "serve_respond",
+    # serve SLO engine (serve/slo.py): a request missed its attached
+    # SLO — TTFT/token-cadence target exceeded, or the deadline passed
+    # while it was still queued (family "deadline" = shed before
+    # prefill, typed DeadlineExceeded)
+    "slo_violation",
 })
 
 
@@ -162,6 +167,33 @@ class FlightRecorder:
                 row["data"] = dict(data)
             out.append(row)
         return out
+
+    def tail(self, n: int = EMBED_TAIL_N,
+             kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        """The last ``n`` events, optionally only those of one ``kind``
+        — the ``/statusz`` "what is this rank doing" slice, bounded by
+        construction (never the whole ring over the wire).  ``n <= 0``
+        means no tail (an ``evts[-0:]`` slice would be the WHOLE
+        ring)."""
+        if n is None or int(n) <= 0:
+            return []
+        evts = self.events()
+        if kind is not None:
+            evts = [e for e in evts if e["kind"] == kind]
+        return evts[-int(n):]
+
+    def events_per_second(self, window_s: float = 60.0) -> float:
+        """Emit rate over (up to) the trailing ``window_s`` seconds —
+        the cheap liveness gauge ``/statusz`` and the rank-status rows
+        report.  The denominator is floored at 1s so a single fresh
+        event reads ~1 ev/s, not a spike."""
+        now = time.monotonic()
+        with self._lock:
+            stamps = [ts for ts, *_rest in self._ring
+                      if now - ts <= window_s]
+        if not stamps:
+            return 0.0
+        return len(stamps) / max(1.0, now - stamps[0])
 
     def clear(self) -> None:
         with self._lock:

@@ -65,6 +65,8 @@ class MetricsRegistry:
         self._extra: Dict[str, float] = {}
         # perf-observatory ledgers (telemetry/perf.py snapshots)
         self._perf: Dict[str, Dict[str, Any]] = {}
+        # live-plane per-rank status rows (telemetry/live.py)
+        self._ranks: Dict[str, Dict[str, Any]] = {}
 
     @staticmethod
     def _label(rank: Any) -> str:
@@ -118,6 +120,17 @@ class MetricsRegistry:
         """A free-form run-level scalar (probe extras)."""
         self._extra[str(name)] = float(value)
 
+    def add_rank_status(self, rank: Any,
+                        status: Mapping[str, Any]) -> None:
+        """One rank's live status row (telemetry/live.py
+        ``LiveSources.rank_status`` shape): kept per rank label so the
+        merged export stays RANK-LABELED — ``rla_tpu_rank_healthy``,
+        ``rla_tpu_rank_global_step`` and
+        ``rla_tpu_rank_events_per_second`` render one sample per rank,
+        which is what a live dashboard keys on."""
+        if status:
+            self._ranks[self._label(rank)] = dict(status)
+
     # -- perf-observatory ledgers (telemetry/perf.py) ------------------- #
     @staticmethod
     def _snap(obj: Any) -> Dict[str, Any]:
@@ -166,6 +179,8 @@ class MetricsRegistry:
                             self._compile.values())},
             "events": dict(self._event_counts),
         }
+        if self._ranks:
+            out["ranks"] = {k: dict(v) for k, v in self._ranks.items()}
         if self._perf:
             out["perf"] = {k: dict(v) for k, v in self._perf.items()}
         if self._extra:
@@ -230,7 +245,8 @@ class MetricsRegistry:
         from ..serve.metrics import ServeMetrics as _SM
         for key in serve_keys:
             gauge = key in ("queue_depth", "busy_s", "throughput_tok_s",
-                            "max_batch") or key in _SM.POOL_GAUGES
+                            "max_batch") or key in _SM.POOL_GAUGES \
+                or key in _SM.SLO_GAUGES
             name = f"rla_tpu_serve_{_prom_name(key)}"
             if not gauge:
                 name = f"{name}_total"
@@ -245,6 +261,16 @@ class MetricsRegistry:
         for kind, n in sorted(self._event_counts.items()):
             add("rla_tpu_events_total", n,
                 f'{{kind="{_prom_name(kind)}"}}', mtype="counter")
+        # live-plane rank rows: key-major like the serve block (one
+        # contiguous family per metric name, one sample per rank)
+        for key, fam in (("healthy", "rla_tpu_rank_healthy"),
+                         ("global_step", "rla_tpu_rank_global_step"),
+                         ("events_per_second",
+                          "rla_tpu_rank_events_per_second")):
+            for rank, row in sorted(self._ranks.items()):
+                val = row.get(key)
+                if isinstance(val, (int, float)):
+                    add(fam, val, f'{{rank="{rank}"}}', mtype="gauge")
         # perf-observatory ledgers: phase seconds, HBM pools, goodput —
         # each family key-major like the serve block (exposition format
         # forbids interleaved families)

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""rla_top: live per-rank view of a running rla-tpu job (stdlib only).
+
+Polls the DRIVER's live-telemetry ``/statusz`` endpoint
+(telemetry/live.py; enabled with ``RLA_TPU_METRICS_PORT``) and renders
+a refreshing table: one row for the driver, one per fan-out rank from
+the driver's ClusterView — health, global step, events/sec, serve
+throughput/burn-rate where an engine is live.
+
+Discovery order:
+  --url URL                  explicit driver endpoint
+  --dir TELEMETRY_DIR        read driver.port.json under the dir
+  (default)                  $RLA_TPU_TELEMETRY_DIR
+
+Usage:
+  python scripts/rla_top.py                 # watch, 2s refresh
+  python scripts/rla_top.py --interval 0.5
+  python scripts/rla_top.py --once          # one snapshot, no screen
+                                            # control (scriptable)
+
+Never imports jax (or the package): a wedged backend cannot take the
+console view down with it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+COLS = ("rank", "health", "beat_age", "step", "ev/s", "serve tok/s",
+        "slo burn", "detail")
+
+
+# proxy-free opener: the driver endpoint is loopback, and a host-level
+# http_proxy would otherwise swallow every poll
+_OPENER = urllib.request.build_opener(urllib.request.ProxyHandler({}))
+
+
+def fetch(url: str, timeout: float = 2.0):
+    try:
+        with _OPENER.open(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}", "url": url}
+
+
+def discover_url(args) -> str:
+    if args.url:
+        return args.url.rstrip("/")
+    tdir = args.dir or os.environ.get("RLA_TPU_TELEMETRY_DIR")
+    if not tdir:
+        sys.exit("rla_top: pass --url, --dir, or set "
+                 "RLA_TPU_TELEMETRY_DIR")
+    path = os.path.join(tdir, "driver.port.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec["url"].rstrip("/")
+    except (OSError, ValueError, KeyError):
+        sys.exit(f"rla_top: no readable driver portfile at {path} "
+                 "(is the run up with RLA_TPU_METRICS_PORT set?)")
+
+
+def _num(x, fmt="{:.1f}", dash="-"):
+    return fmt.format(x) if isinstance(x, (int, float)) else dash
+
+
+def _serve_cells(serve: dict):
+    """(tok/s, burn) summed/maxed across a rank's engines."""
+    if not serve:
+        return "-", "-"
+    tok = sum(s.get("throughput_tok_s") or 0.0 for s in serve.values())
+    burns = [s.get("slo_burn_rate") for s in serve.values()
+             if isinstance(s.get("slo_burn_rate"), (int, float))]
+    return _num(tok), (_num(max(burns), "{:.2f}") if burns else "-")
+
+
+def rows_from_statusz(status: dict):
+    """One row per rank: the driver itself + its cluster view ranks."""
+    rows = []
+
+    def row_of(label, r):
+        health = r.get("health") or {}
+        serve = r.get("serve") or {}
+        tok, burn = _serve_cells(serve)
+        rows.append((
+            str(label),
+            health.get("status", "?"),
+            _num(health.get("beat_age_s"), "{:.1f}s"),
+            str(r.get("global_step", "-")),
+            _num(r.get("events_per_second"), "{:.1f}"),
+            tok, burn,
+            (health.get("detail") or "")[:40],
+        ))
+
+    drv = dict(status)
+    drv["serve"] = status.get("serve") or {}
+    row_of(status.get("rank", "driver"), drv)
+    cluster = (status.get("cluster") or {}).get("ranks") or {}
+    for label in sorted(cluster, key=lambda x: (len(x), x)):
+        row_of(label, cluster[label])
+    return rows
+
+
+def render(status: dict) -> str:
+    lines = []
+    if "error" in status:
+        return (f"rla_top: driver unreachable — {status['error']}\n"
+                f"  ({status.get('url', '?')})")
+    refreshed = (status.get("cluster") or {}).get("refreshed_age_s")
+    head = (f"trace={status.get('trace_id') or '-'}  "
+            f"step={status.get('global_step', '-')}  "
+            f"ranks_refreshed="
+            f"{_num(refreshed, '{:.1f}s') if refreshed is not None else '-'}")
+    lines.append(head)
+    rows = rows_from_statusz(status)
+    widths = [max(len(str(c)), *(len(r[i]) for r in rows))
+              for i, c in enumerate(COLS)]
+    fmt = "  ".join("{:<%d}" % w for w in widths)
+    lines.append(fmt.format(*COLS))
+    for r in rows:
+        lines.append(fmt.format(*r))
+    tl = status.get("step_timeline")
+    if tl:
+        lines.append(
+            f"timeline: {tl.get('steps', 0)} steps, "
+            f"mean {_num(tl.get('mean_step_ms'), '{:.1f}')}ms, "
+            f"attributed {_num(tl.get('attributed_fraction'), '{:.2f}')}")
+    hbm = status.get("hbm")
+    if hbm:
+        pools = ", ".join(f"{k}={v / 1e6:.1f}MB"
+                          for k, v in sorted(
+                              (hbm.get("pools") or {}).items())
+                          if isinstance(v, (int, float)) and v)
+        lines.append(f"hbm: total {hbm.get('total_bytes', 0) / 1e6:.1f}MB"
+                     f" ({pools})" + (
+                         f"  LEAK ALARMS={hbm['leak_alarms']}"
+                         if hbm.get("leak_alarms") else ""))
+    gp = status.get("goodput")
+    if gp:
+        frac = _num(gp.get("goodput_fraction"), "{:.2f}")
+        lines.append(f"goodput: {frac} over "
+                     f"{_num(gp.get('wall_s'))}s wall")
+    slo = status.get("slo")
+    if slo:
+        for label, t in sorted(slo.items()):
+            fams = ", ".join(
+                f"{k}:{v.get('violations', 0)}/{v.get('observations', 0)}"
+                for k, v in sorted((t.get("families") or {}).items()))
+            lines.append(f"slo[{label}]: burn "
+                         f"{_num(t.get('burn_rate'), '{:.2f}')} ({fams})")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="driver endpoint, e.g. http://127.0.0.1:9090")
+    ap.add_argument("--dir", default=None,
+                    help="telemetry dir holding driver.port.json "
+                         "(default: $RLA_TPU_TELEMETRY_DIR)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen "
+                         "control; scriptable)")
+    args = ap.parse_args()
+    url = discover_url(args)
+    if args.once:
+        print(render(fetch(url + "/statusz")))
+        return
+    try:
+        while True:
+            frame = render(fetch(url + "/statusz"))
+            # clear + home, then the frame — plain ANSI, no curses dep
+            sys.stdout.write("\x1b[2J\x1b[H"
+                             + time.strftime("%H:%M:%S ") + url + "\n"
+                             + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,670 @@
+"""Live telemetry plane (telemetry/live.py) + serve SLO engine
+(serve/slo.py).
+
+The acceptance loops:
+
+- during a LIVE fit, the driver's ``/metrics`` endpoint answers
+  exposition-valid Prometheus spanning trainer + prefetch + HBM +
+  goodput families while steps are still running (validated with the
+  same grammar check test_telemetry applies to the end-of-run export),
+  and the run stays zero-retrace with the plane enabled;
+- a chaos ``hang@rank0`` flips that rank's own ``/healthz`` to wedged
+  (HTTP 503) BEFORE any driver watchdog reaps it;
+- a ClusterView over live worker endpoints merges rank-labeled
+  (portfile scrape locally, the agent ``live`` wire op remotely);
+- an overloaded serve workload reports a NONZERO SLO burn rate and
+  typed deadline sheds before prefill; a light workload reports zero.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.serve.slo import (DeadlineExceeded,
+                                                      SloPolicy,
+                                                      SloTracker)
+from ray_lightning_accelerators_tpu.telemetry import live
+from ray_lightning_accelerators_tpu.telemetry import recorder as R
+from tests.utils import assert_prometheus_exposition
+
+pytestmark = pytest.mark.live
+
+HB = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _fresh_live_plane():
+    """Each test gets a clean process server + recorder."""
+    live._reset_for_tests()
+    R._reset_for_tests()
+    yield
+    live._reset_for_tests()
+    R._reset_for_tests()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# --------------------------------------------------------------------- #
+# TelemetryServer endpoints + portfile discovery                          #
+# --------------------------------------------------------------------- #
+def test_server_endpoints_and_portfile(tmp_path, monkeypatch):
+    monkeypatch.setenv("RLA_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("RLA_TPU_METRICS_PORT", "0")
+    R.configure(trace_id="t-live")
+    R.emit("fit_start", step=0)
+    srv = live.maybe_start_from_env()
+    assert srv is not None and srv.port and srv.url
+    # starting again returns the SAME server (once per process)
+    assert live.maybe_start_from_env() is srv
+
+    code, body = _get(srv.url + "/metrics")
+    assert code == 200
+    assert_prometheus_exposition(body)
+    assert 'rla_tpu_events_total{kind="fit_start"} 1' in body
+    assert 'rla_tpu_rank_healthy{rank="driver"} 1' in body
+
+    code, body = _get(srv.url + "/statusz")
+    status = json.loads(body)
+    assert status["rank"] == "driver" and status["trace_id"] == "t-live"
+    assert status["flight_tail"][-1]["kind"] == "fit_start"
+    assert status["health"]["status"] == "ok"
+
+    code, body = _get(srv.url + "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+
+    code, body = _get(srv.url + "/snapshot")
+    snap = json.loads(body)
+    assert snap["rank"] == "driver"
+    assert [e["kind"] for e in snap["events"]] == ["fit_start"]
+
+    # 404 names the known paths
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.url + "/nope")
+    assert ei.value.code == 404
+
+    # portfile discovery (the ClusterView/rla_top channel)
+    path = live.portfile_for(None)
+    assert path == str(tmp_path / "driver.port.json")
+    rec = live.read_portfile(path)
+    assert rec["port"] == srv.port and rec["rank"] == "driver"
+    assert live.scrape_rank(None)["rank"] == "driver"
+    # shutdown removes the portfile
+    live.shutdown_server()
+    assert live.read_portfile(path) is None
+
+
+def test_server_disabled_without_knob(monkeypatch):
+    monkeypatch.delenv("RLA_TPU_METRICS_PORT", raising=False)
+    assert live.maybe_start_from_env() is None
+
+
+def test_classify_health_matches_watchdog_thresholds():
+    # no channel => liveness-only ok (the watchdog's no-false-positive
+    # rule)
+    assert live.classify_health(None)["status"] == "ok"
+    ok = live.classify_health({"beat_age_s": 0.1, "busy_s": None,
+                               "started": True}, wedge_timeout_s=1.0)
+    assert ok["status"] == "ok"
+    slow = live.classify_health({"beat_age_s": 0.1, "busy_s": 0.8,
+                                 "started": True}, wedge_timeout_s=1.0)
+    assert slow["status"] == "slow" and "straggler" in slow["detail"]
+    wedged = live.classify_health({"beat_age_s": 1.5, "busy_s": None,
+                                   "started": True}, wedge_timeout_s=1.0)
+    assert wedged["status"] == "wedged"
+    # booting rank: judged by boot grace, not the wedge timeout
+    booting = live.classify_health({"beat_age_s": 1.5, "busy_s": None,
+                                    "started": False},
+                                   wedge_timeout_s=1.0,
+                                   boot_grace_s=60.0)
+    assert booting["status"] == "ok"
+    # a configured dispatch deadline wedges a busy-past-it rank (the
+    # watchdog's second wedged rule) and halves the slow trigger
+    dl = live.classify_health({"beat_age_s": 0.1, "busy_s": 40.0,
+                               "started": True}, wedge_timeout_s=60.0,
+                              dispatch_deadline_s=30.0)
+    assert dl["status"] == "wedged" and "deadline" in dl["detail"]
+    dl_slow = live.classify_health({"beat_age_s": 0.1, "busy_s": 20.0,
+                                    "started": True},
+                                   wedge_timeout_s=60.0,
+                                   dispatch_deadline_s=30.0)
+    assert dl_slow["status"] == "slow"
+
+
+# --------------------------------------------------------------------- #
+# Satellites: recorder tail/rate, consistent ServeMetrics snapshot        #
+# --------------------------------------------------------------------- #
+def test_flight_recorder_tail_filter_and_rate():
+    rec = R.FlightRecorder(capacity=64, rank=1)
+    for i in range(10):
+        rec.emit("train_step", step=i)
+        rec.emit("serve_decode_step", active=1)
+    tail = rec.tail(4, kind="train_step")
+    assert [e["data"]["step"] for e in tail] == [6, 7, 8, 9]
+    assert all(e["kind"] == "train_step" for e in tail)
+    assert rec.tail(3) == rec.events()[-3:]
+    assert rec.tail(0) == []  # n<=0 = no tail, never the whole ring
+    assert rec.tail(-1) == []
+    # 20 events just emitted within the window; floor-1s denominator
+    assert rec.events_per_second(window_s=60.0) == pytest.approx(20.0)
+    assert R.FlightRecorder().events_per_second() == 0.0
+
+
+def test_serve_metrics_snapshot_never_tears_under_concurrent_writers():
+    """Satellite: a live scrape racing concurrent observe_* calls must
+    see reservoir counts and their paired counters move TOGETHER — the
+    prefill reservoir can never lead/lag the prefills counter, steps
+    likewise."""
+    from ray_lightning_accelerators_tpu.serve.metrics import ServeMetrics
+    m = ServeMetrics()
+    stop = threading.Event()
+    N = 3000
+
+    def prefiller():
+        for _ in range(N):
+            m.observe_prefill(1e-6)
+
+    def stepper():
+        for _ in range(N):
+            m.observe_step(1e-6, active=1)
+
+    tears = []
+
+    def reader():
+        while not stop.is_set():
+            snap = m.snapshot()
+            pf = snap["prefill_s"]["count"] if snap["prefill_s"] else 0
+            st = (snap["decode_step_s"]["count"]
+                  if snap["decode_step_s"] else 0)
+            if pf != snap["prefills"] or st != snap["steps"]:
+                tears.append((pf, snap["prefills"], st, snap["steps"]))
+            # tokens = prefills + steps (active=1) must never be ahead
+            # of what the counters say
+            if snap["tokens_generated"] != snap["prefills"] \
+                    + snap["steps"]:
+                tears.append(("tokens", snap["tokens_generated"],
+                              snap["prefills"], snap["steps"]))
+
+    writers = [threading.Thread(target=prefiller),
+               threading.Thread(target=stepper)]
+    rd = threading.Thread(target=reader)
+    rd.start()
+    for w in writers:
+        w.start()
+    for w in writers:
+        w.join()
+    stop.set()
+    rd.join()
+    assert not tears, f"snapshot tore {len(tears)}x, e.g. {tears[:3]}"
+    final = m.snapshot()
+    assert final["prefills"] == N and final["steps"] == N
+    assert final["prefill_s"]["count"] == N
+    assert final["decode_step_s"]["count"] == N
+
+
+# --------------------------------------------------------------------- #
+# Mid-fit live scrape (the acceptance slice)                              #
+# --------------------------------------------------------------------- #
+def test_live_metrics_midfit_scrape(tmp_path, monkeypatch):
+    """While a fit is RUNNING, the driver /metrics answers exposition-
+    valid Prometheus spanning trainer spans, prefetch accounting, HBM
+    pools, step phases and goodput; /statusz carries timeline rows and
+    global_step — and the plane adds zero retraces (compile-guard)."""
+    from ray_lightning_accelerators_tpu import Callback, DataLoader, Trainer
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
+    from ray_lightning_accelerators_tpu.data.loader import RandomDataset
+    from ray_lightning_accelerators_tpu.telemetry.perf import PerfObservatory
+    from ray_lightning_accelerators_tpu.utils.profiler import Profiler
+    from tests.utils import BoringModel
+
+    monkeypatch.setenv("RLA_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("RLA_TPU_METRICS_PORT", "0")
+    cg.install()
+    perf = PerfObservatory()
+    perf.goodput.run_begin()  # feed the goodput ledger so it exports
+
+    scraped = {}
+
+    class MidFitScrape(Callback):
+        def __init__(self):
+            self.compiles = []
+
+        def on_train_batch_end(self, trainer, module, metrics, idx):
+            self.compiles.append(cg.compile_count())
+            if trainer.global_step == 5 and not scraped:
+                srv = live.get_server()
+                assert srv is not None
+                _, scraped["metrics"] = _get(srv.url + "/metrics")
+                _, scraped["statusz"] = _get(srv.url + "/statusz")
+
+    clock = MidFitScrape()
+    trainer = Trainer(max_steps=12, precision="f32", seed=0,
+                      enable_checkpointing=False,
+                      prefetch_batches=2, perf_observatory=perf,
+                      profiler=Profiler(),
+                      cache_dataset_on_device=False,
+                      log_every_n_steps=10 ** 9, callbacks=[clock],
+                      default_root_dir=str(tmp_path))
+    trainer.fit(BoringModel(),
+                DataLoader(RandomDataset(32, 96), batch_size=8))
+    perf.goodput.run_end()
+
+    assert scraped, "mid-fit scrape never ran"
+    body = scraped["metrics"]
+    assert_prometheus_exposition(body)
+    for needle in ('rla_tpu_span_seconds{span="train_step"',   # trainer
+                   "rla_tpu_prefetch_depth",                   # prefetch
+                   "rla_tpu_hbm_total_bytes",                  # HBM
+                   "rla_tpu_step_phase_seconds_total",         # timeline
+                   "rla_tpu_goodput_wall_seconds",             # goodput
+                   'rla_tpu_rank_healthy{rank="driver"}',      # rank row
+                   'rla_tpu_events_total{kind="train_step"}'):
+        assert needle in body, f"{needle!r} missing from live scrape"
+    status = json.loads(scraped["statusz"])
+    assert status["global_step"] == 5
+    assert status["step_timeline"]["steps"] >= 4
+    assert status["recent_steps"], "no live timeline rows"
+    assert status["hbm"]["total_bytes"] >= 0
+    # the plane added ZERO retraces after warmup
+    assert clock.compiles[-1] == clock.compiles[2], clock.compiles
+    # the driver server stays scrapeable after fit (last state)
+    srv = live.get_server()
+    _, after = _get(srv.url + "/metrics")
+    assert_prometheus_exposition(after)
+
+
+# --------------------------------------------------------------------- #
+# Chaos: a hung rank's own /healthz flips to wedged pre-reap              #
+# --------------------------------------------------------------------- #
+def _ok(x=1):
+    return x * 2
+
+
+@pytest.mark.chaos
+def test_chaos_hang_flips_worker_healthz_before_watchdog_reap(tmp_path):
+    from ray_lightning_accelerators_tpu.runtime.actors import Worker
+    from ray_lightning_accelerators_tpu.runtime.watchdog import (
+        Watchdog, WorkerWedged)
+    env = {"RLA_TPU_CHAOS": "hang@rank0",
+           "RLA_TPU_WORKER_HEARTBEAT_S": str(HB),
+           "RLA_TPU_WEDGE_TIMEOUT_S": "0.6",
+           "RLA_TPU_TELEMETRY_DIR": str(tmp_path),
+           "RLA_TPU_METRICS_PORT": "0"}
+    w = Worker(0, env=env)
+    wd = None
+    try:
+        fut = w.execute(_ok)
+        # the rank's own endpoint: poll until its frozen beat crosses
+        # the wedge threshold — NO watchdog is running yet
+        deadline = time.monotonic() + 60
+        status = None
+        while time.monotonic() < deadline:
+            rec = live.read_portfile(live.portfile_for(0, env=env))
+            if rec is not None:
+                try:
+                    _get(f"http://127.0.0.1:{rec['port']}/healthz",
+                         timeout=2)
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:  # wedged reads as NOT-ready
+                        status = json.loads(e.read().decode())
+                        break
+                except Exception:
+                    pass
+            time.sleep(HB)
+        assert status is not None, "healthz never flipped to wedged"
+        assert status["status"] == "wedged"
+        assert status["beat_age_s"] > 0.6
+        # the watchdog reaps ONLY NOW — the live signal preceded it
+        wd = Watchdog([w], wedge_timeout_s=0.6, poll_s=HB).start()
+        with pytest.raises(WorkerWedged):
+            fut.result(timeout=120)
+    finally:
+        if wd is not None:
+            wd.stop()
+        w.kill()
+
+
+# --------------------------------------------------------------------- #
+# ClusterView: local pool + agent relay                                   #
+# --------------------------------------------------------------------- #
+def _emit_steps(n):
+    from ray_lightning_accelerators_tpu.telemetry import emit
+    for i in range(n):
+        emit("train_step", step=i)
+    return n
+
+
+def test_cluster_view_merges_rank_labeled(tmp_path):
+    from ray_lightning_accelerators_tpu.runtime.actors import ActorPool
+    env = {"RLA_TPU_TELEMETRY_DIR": str(tmp_path),
+           "RLA_TPU_METRICS_PORT": "0",
+           "RLA_TPU_WORKER_HEARTBEAT_S": str(HB)}
+    pool = ActorPool(2, env_per_worker=[dict(env), dict(env)])
+    try:
+        for f in pool.execute_all(_emit_steps, 5):
+            assert f.result(timeout=120) == 5
+        cv = live.ClusterView(workers=list(pool.workers), refresh_s=0.2)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(cv.view()) < 2:
+            cv.refresh()
+            time.sleep(0.1)
+        assert sorted(cv.view()) == ["0", "1"]
+        txt = cv.merged_registry().prometheus_text()
+        assert_prometheus_exposition(txt)
+        assert 'rla_tpu_rank_healthy{rank="0"} 1' in txt
+        assert 'rla_tpu_rank_healthy{rank="1"} 1' in txt
+        assert 'rla_tpu_rank_events_per_second{rank="0"}' in txt
+        # events merged into the per-kind tallies
+        j = cv.merged_registry().to_json()
+        assert j["events"].get("train_step", 0) >= 10
+        assert j["ranks"]["0"]["health"]["status"] == "ok"
+        # the compact last_view (run-report shape) carries status rows
+        view = cv.last_view()
+        assert sorted(view["ranks"]) == ["0", "1"]
+        assert view["ranks"]["1"]["healthy"] == 1.0
+        # a dead rank drops from fresh sweeps but its LAST snapshot
+        # survives in the merged view (the before-death property)
+        pool.workers[1].kill()
+        cv.refresh()
+        assert "1" in cv.view()
+    finally:
+        pool.shutdown()
+
+
+def test_cluster_view_portfile_scan_without_pool(tmp_path):
+    """The pool-independent mode (rla_top/serve): portfiles under the
+    telemetry dir are discovered directly."""
+    from ray_lightning_accelerators_tpu.runtime.actors import Worker
+    env = {"RLA_TPU_TELEMETRY_DIR": str(tmp_path),
+           "RLA_TPU_METRICS_PORT": "0",
+           "RLA_TPU_WORKER_HEARTBEAT_S": str(HB)}
+    w = Worker(0, env=env)
+    try:
+        assert w.execute(_emit_steps, 3).result(timeout=120) == 3
+        cv = live.ClusterView(env=env)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not cv.view():
+            cv.refresh()
+            time.sleep(0.1)
+        assert "0" in cv.view()
+    finally:
+        w.kill()
+
+
+def test_live_wire_op_over_agent_relay(tmp_path):
+    """The remote seam: a RemoteWorker's live_snapshot rides the agent
+    ``live`` wire op (the scrape happens on the worker's own host)."""
+    from ray_lightning_accelerators_tpu.runtime.agent import (HostAgent,
+                                                              RemoteWorker)
+    agent = HostAgent(port=0, bind="127.0.0.1")
+    agent.serve_in_background()
+    env = {"RLA_TPU_TELEMETRY_DIR": str(tmp_path),
+           "RLA_TPU_METRICS_PORT": "0",
+           "RLA_TPU_WORKER_HEARTBEAT_S": str(HB)}
+    w = None
+    try:
+        w = RemoteWorker(f"127.0.0.1:{agent.port}", rank=1, env=env)
+        assert w.execute(_emit_steps, 4).result(timeout=120) == 4
+        deadline = time.monotonic() + 60
+        snap = None
+        while time.monotonic() < deadline:
+            snap = w.live_snapshot()
+            if snap:
+                break
+            time.sleep(0.1)
+        assert snap is not None and snap["rank"] == "1"
+        assert snap["status"]["health"]["status"] == "ok"
+        cv = live.ClusterView(workers=[w], refresh_s=0.2)
+        cv.refresh()
+        assert "1" in cv.view()
+    finally:
+        if w is not None:
+            w.kill()
+        agent.shutdown()
+
+
+def test_fanned_out_fit_wires_cluster_view(tmp_path, monkeypatch):
+    """THE driver seam: a fanned-out fit with the plane enabled starts
+    the driver server, aggregates the worker rank through a ClusterView
+    (agent `live` wire op), re-exports it rank-labeled on the driver's
+    /metrics, and keeps the last view for the run report."""
+    from ray_lightning_accelerators_tpu import (DataLoader,
+                                                HorovodRayAccelerator,
+                                                Trainer)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.runtime.agent import HostAgent
+    from tests.utils import BoringModel
+
+    monkeypatch.setenv("RLA_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("RLA_TPU_METRICS_PORT", "0")
+    monkeypatch.setenv("RLA_TPU_LIVE_REFRESH_S", "0.2")
+    agent = HostAgent(port=0, bind="127.0.0.1")
+    agent.serve_in_background()
+    trainer = None
+    try:
+        x = np.random.default_rng(0).normal(size=(32, 32)).astype(
+            "float32")
+        trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+                          enable_checkpointing=False,
+                          accelerator=HorovodRayAccelerator(
+                              num_hosts=1, num_slots=1,
+                              agents=[f"127.0.0.1:{agent.port}"]),
+                          default_root_dir=str(tmp_path))
+        trainer.fit(BoringModel(),
+                    DataLoader(ArrayDataset(x), batch_size=8,
+                               shuffle=False))
+        srv = live.get_server()
+        assert srv is not None
+        assert trainer._cluster_view is not None
+        # the worker rank made it into the aggregated view (the agent
+        # `live` op scraped its portfile-published endpoint)
+        view = trainer._cluster_view.last_view()
+        assert "0" in view["ranks"], view
+        _, body = _get(srv.url + "/metrics")
+        assert_prometheus_exposition(body)
+        assert 'rla_tpu_rank_healthy{rank="driver"}' in body
+        assert 'rla_tpu_rank_healthy{rank="0"}' in body
+    finally:
+        if trainer is not None:
+            trainer.teardown()
+        agent.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# Serve SLO engine                                                        #
+# --------------------------------------------------------------------- #
+def test_slo_policy_validation_and_env(monkeypatch):
+    with pytest.raises(ValueError):
+        SloPolicy(ttft_target_s=0.0)
+    with pytest.raises(ValueError):
+        SloPolicy(ttft_target_s=1.0, target_fraction=1.0)
+    assert SloPolicy().enabled is False
+    assert SloPolicy.from_env() is None  # no knob set
+    monkeypatch.setenv("RLA_TPU_SLO_TTFT_S", "0.25")
+    monkeypatch.setenv("RLA_TPU_SLO_DEADLINE_S", "2.0")
+    pol = SloPolicy.from_env()
+    assert pol is not None and pol.ttft_target_s == 0.25
+    assert pol.deadline_s == 2.0 and pol.target_fraction == 0.99
+
+
+def test_slo_tracker_burn_rate_math():
+    pol = SloPolicy(ttft_target_s=0.1, target_fraction=0.9)
+
+    class Req:
+        trace_id = "t"
+        request_id = 0
+
+    t = SloTracker(pol, window_s=60.0)
+    for _ in range(8):
+        t.observe_ttft(0.01, Req())   # ok
+    assert t.burn_rate() == 0.0
+    t.observe_ttft(0.5, Req())        # 1 violation / 9 obs
+    t.observe_ttft(0.5, Req())        # 2 / 10
+    # violation fraction 0.2 over allowed 0.1 => burn 2.0
+    assert t.burn_rate() == pytest.approx(2.0)
+    snap = t.snapshot()
+    assert snap["families"]["ttft"]["violations"] == 2
+    assert snap["families"]["ttft"]["observations"] == 10
+    # violations emitted typed flight-recorder events
+    kinds = [e["kind"] for e in R.get_recorder().events()]
+    assert kinds.count("slo_violation") == 2
+
+
+def test_deadline_propagates_through_requeue():
+    from ray_lightning_accelerators_tpu.serve.batcher import (
+        AdmissionController)
+    pol = SloPolicy(deadline_s=5.0)
+    ac = AdmissionController(queue_depth=4, max_total_len=64,
+                             slo_policy=pol)
+    resp = ac.submit(np.arange(4, dtype=np.int32), 4)
+    req = resp.request
+    assert req.deadline == pytest.approx(req.t_submit + 5.0)
+    item = ac.pop()
+    assert item[0] is req
+    # an infra requeue keeps the ORIGINAL deadline (the client's clock
+    # never resets on retry)
+    ac.requeue(req, resp)
+    req2, _ = ac.pop()
+    assert req2 is req
+    assert req2.deadline == pytest.approx(req.t_submit + 5.0)
+    ac.shutdown()
+
+
+def _tiny_gpt():
+    import jax
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            d_ff=64, n_layers=2, max_seq_len=64)
+    model = GPT(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+@pytest.mark.serve
+def test_engine_sheds_expired_deadline_typed_before_prefill():
+    from ray_lightning_accelerators_tpu.serve import ServeEngine
+    model, params = _tiny_gpt()
+    rng = np.random.default_rng(0)
+    engine = ServeEngine(model, params, max_slots=1,
+                         slo=SloPolicy(deadline_s=0.001))
+    # submitted BEFORE start: the request ages past its deadline queued
+    h = engine.submit(rng.integers(0, 61, size=(5,)).astype(np.int32), 4)
+    time.sleep(0.05)
+    engine.start()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=60)
+        snap = engine.metrics.snapshot()
+        assert snap["slo_deadline_shed"] == 1
+        assert snap["failed"] == 1          # accounted terminally
+        assert snap["prefills"] == 0        # shed BEFORE prefill
+        # the shed IS a deadline-family violation (burn-rate fuel) AND
+        # counts in its own dedicated shed counter
+        assert snap["slo_violations"] == 1
+        kinds = [e["kind"] for e in R.get_recorder().events()]
+        assert "slo_violation" in kinds
+    finally:
+        engine.stop()
+
+
+@pytest.mark.serve
+def test_engine_burn_rate_overloaded_nonzero_light_zero():
+    from ray_lightning_accelerators_tpu.serve import ServeEngine
+    model, params = _tiny_gpt()
+    rng = np.random.default_rng(0)
+
+    def run(policy):
+        with ServeEngine(model, params, max_slots=2,
+                         slo=policy) as engine:
+            hs = [engine.submit(rng.integers(0, 61, size=(5,))
+                                .astype(np.int32), 4) for _ in range(4)]
+            for h in hs:
+                h.result(timeout=120)
+            return engine.metrics.snapshot()
+
+    hot = run(SloPolicy(ttft_target_s=1e-6, token_cadence_target_s=1e-6))
+    assert hot["slo_burn_rate"] > 0
+    assert hot["slo_violations"] >= 4       # every TTFT violated
+    assert hot["completed"] == 4            # violations don't fail work
+    cold = run(SloPolicy(ttft_target_s=300.0,
+                         token_cadence_target_s=300.0))
+    assert cold["slo_burn_rate"] == 0.0
+    assert cold["slo_violations"] == 0
+    # the gauges render typed through the registry export
+    from ray_lightning_accelerators_tpu.telemetry.registry import (
+        MetricsRegistry)
+    reg = MetricsRegistry()
+    reg.add_serve(hot, rank="e0")
+    txt = reg.prometheus_text()
+    assert_prometheus_exposition(txt)
+    assert "# TYPE rla_tpu_serve_slo_burn_rate gauge" in txt
+    assert "# TYPE rla_tpu_serve_slo_violations_total counter" in txt
+
+
+@pytest.mark.serve
+def test_engine_without_slo_has_no_slo_overhead_fields():
+    from ray_lightning_accelerators_tpu.serve import ServeEngine
+    model, params = _tiny_gpt()
+    rng = np.random.default_rng(0)
+    with ServeEngine(model, params, max_slots=1, slo=None) as engine:
+        h = engine.submit(rng.integers(0, 61, size=(5,))
+                          .astype(np.int32), 3)
+        h.result(timeout=120)
+        snap = engine.metrics.snapshot()
+    assert engine._slo is None
+    assert "slo_burn_rate" not in snap
+    assert snap["slo_violations"] == 0  # counter exists, stays zero
+
+
+# --------------------------------------------------------------------- #
+# Failure report embeds the last live view                                #
+# --------------------------------------------------------------------- #
+def test_fit_failure_report_embeds_cluster_view(tmp_path):
+    from ray_lightning_accelerators_tpu import DataLoader, Trainer
+    from ray_lightning_accelerators_tpu.data.loader import RandomDataset
+    from tests.utils import BoringModel
+
+    class Poison(Exception):
+        pass
+
+    class Bomb:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __iter__(self):
+            yield from list(self.inner)[:2]
+            raise Poison("poisoned batch 3")
+
+        def __len__(self):
+            return len(self.inner)
+
+    trainer = Trainer(max_steps=8, precision="f32", seed=0,
+                      enable_checkpointing=False, prefetch_batches=0,
+                      cache_dataset_on_device=False,
+                      log_every_n_steps=10 ** 9,
+                      default_root_dir=str(tmp_path))
+    # a cluster view with one collected rank (simulating the fan-out
+    # driver's aggregator at death time)
+    cv = live.ClusterView(workers=[], refresh_s=10.0)
+    cv._view = {"0": {"status": {"rank": "0", "healthy": 1.0,
+                                 "global_step": 7,
+                                 "health": {"status": "ok"}}}}
+    cv._refreshed_at = time.monotonic()
+    trainer._cluster_view = cv
+    with pytest.raises(Poison):
+        trainer.fit(BoringModel(),
+                    Bomb(DataLoader(RandomDataset(32, 64),
+                                    batch_size=8)))
+    rep = json.load(open(os.path.join(str(tmp_path),
+                                      "run_report.json")))
+    view = rep["extra"]["cluster_view"]
+    assert view["ranks"]["0"]["global_step"] == 7
+    # the merged metrics snapshot carries the rank-labeled status row
+    assert rep["metrics"]["ranks"]["0"]["healthy"] == 1.0

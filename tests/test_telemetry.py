@@ -204,15 +204,9 @@ def test_registry_prometheus_export():
     assert "rla_tpu_backend_compiles_total 7" in txt
     assert 'rla_tpu_events_total{kind="train_step"} 2' in txt
     # exposition-format sanity: every sample line is name{labels} value
-    import re
-    sample = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
-                        r'(\{[a-zA-Z0-9_]+="[^"]*"'
-                        r'(,[a-zA-Z0-9_]+="[^"]*")*\})? '
-                        r"-?[0-9.eE+-]+(inf|nan)?$")
-    for line in txt.splitlines():
-        if line.startswith("#") or not line:
-            continue
-        assert sample.match(line), f"malformed exposition line: {line!r}"
+    # (shared validator — test_live applies the SAME one to live scrapes)
+    from tests.utils import assert_prometheus_exposition
+    assert_prometheus_exposition(txt)
 
 
 def test_serve_metrics_reset_clears_every_structure():

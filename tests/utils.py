@@ -180,3 +180,26 @@ def predict_test(trainer, model, dm):
         total += len(y)
     acc = correct / total
     assert acc >= 0.5, f"expected accuracy >= 0.5, got {acc}"
+
+
+# --------------------------------------------------------------------- #
+# Prometheus exposition-format validation (shared by test_telemetry's   #
+# end-of-run export checks and test_live's live-scrape checks)          #
+# --------------------------------------------------------------------- #
+import re  # noqa: E402
+
+PROM_SAMPLE_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+                            r'(\{[a-zA-Z0-9_]+="[^"]*"'
+                            r'(,[a-zA-Z0-9_]+="[^"]*")*\})? '
+                            r"-?[0-9.eE+-]+(inf|nan)?$")
+
+
+def assert_prometheus_exposition(text: str) -> None:
+    """Every non-comment line must be a well-formed sample
+    (``name{labels} value``), and the text must not be empty."""
+    assert text.strip(), "empty exposition body"
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert PROM_SAMPLE_RE.match(line), \
+            f"malformed exposition line: {line!r}"
