@@ -20,14 +20,17 @@
   matmul-shaped read (the access pattern decode actually has).
 
 - ``gradexchange`` / ``input_pipeline`` / ``fsdp_exchange`` /
-  ``paged_serve`` / ``mfu_overlap`` (CPU-mesh subprocess benches):
+  ``paged_serve`` / ``mfu_overlap`` / ``perf_observatory`` /
+  ``live_plane`` / ``serve_resilience`` (CPU-mesh subprocess benches):
   quantized-allreduce wire-bytes reduction, async-input-pipeline
   prefetch speedup, compressed-FSDP exchange, paged-KV-cache
-  concurrency-per-HBM, and the overlap-aware scan-gather + step
-  autotune loop, each measured by a self-contained probe script that
-  forces an 8-device host-platform CPU mesh before backend init.  They
-  double as the dead-backend fallback set: a window whose accelerator
-  probe fails still emits their real metric lines and exits 0.
+  concurrency-per-HBM, the overlap-aware scan-gather + step autotune
+  loop, the perf-observatory ledgers, the live telemetry plane, and
+  the serve-tier chaos-resilience window, each measured by a
+  self-contained probe script that forces an 8-device host-platform
+  CPU mesh before backend init.  They double as the dead-backend
+  fallback set: a window whose accelerator probe fails still emits
+  their real metric lines and exits 0.
 
 Each timed region is the steady state of a single public-API ``fit`` --
 epoch 1 absorbs compile + the one-time device-cache shipment, later epochs
@@ -730,6 +733,17 @@ def bench_live_plane() -> dict:
     return _run_cpu_probe("live_plane_probe.py", "live_plane")
 
 
+def bench_serve_resilience() -> dict:
+    """Serve-tier resilience bench (serve/controller.py + replicas):
+    completed-request fraction and p99 TTFT across a replica chaos
+    window (1 replica killed + 1 hung mid-run, circuit-breaker
+    auto-revival, head-of-line requeue with retry backoff) vs a
+    no-chaos baseline — on a forced-host-platform 8-device CPU mesh
+    (see ``_run_cpu_probe``)."""
+    return _run_cpu_probe("serve_resilience_probe.py",
+                          "serve_resilience")
+
+
 def bench_perf_observatory() -> dict:
     """Perf-observatory bench (telemetry/perf.py): one 8-dev CPU-mesh
     training run whose per-step phase timeline, HBM pool ledger and
@@ -747,7 +761,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "paged_serve": bench_paged_serve,
            "mfu_overlap": bench_mfu_overlap,
            "perf_observatory": bench_perf_observatory,
-           "live_plane": bench_live_plane}
+           "live_plane": bench_live_plane,
+           "serve_resilience": bench_serve_resilience}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -772,7 +787,8 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 # so they double as the probe-failure fallback set
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
-                         "perf_observatory", "live_plane")
+                         "perf_observatory", "live_plane",
+                         "serve_resilience")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -876,7 +892,7 @@ def main() -> None:
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
                 "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory,"
-                "live_plane",
+                "live_plane,serve_resilience",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--gate", action="store_true",
                         help="run no benches: gate a bench window "

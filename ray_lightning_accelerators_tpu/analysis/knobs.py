@@ -158,6 +158,48 @@ _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
 _register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
                "preemption grace budget in seconds; setting it installs "
                "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_SERVE_BREAKER_FAILURES", "int", 3,
+               "serve circuit breaker: failures in the rolling window "
+               "before the reopen backoff starts growing exponentially "
+               "(below it every open waits the base delay) "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_BREAKER_WINDOW_S", "float", 30.0,
+               "serve circuit breaker rolling failure window in seconds "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_BROWNOUT_FRAC", "float", 0.9,
+               "queue-depth fraction past which a saturated tier with "
+               "no scale-up headroom sheds typed BrownoutShed "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_HEDGE", "bool", True,
+               "hedged re-dispatch of a slow replica's oldest in-flight "
+               "chunk onto a healthy replica (serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_MAX_REPLICAS", "int", None,
+               "autoscale ceiling on serve replica count; unset "
+               "disables scale-up (serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_MAX_RETRIES", "int", 2,
+               "per-request infra-failure retry budget before a serve "
+               "request fails typed (serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_RETRY_BACKOFF_S", "float", 0.02,
+               "base seconds of the serve request-retry exponential "
+               "backoff (utils/backoff.py schedule; "
+               "serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_RETRY_BACKOFF_CAP_S", "float", 1.0,
+               "cap on the serve request-retry backoff "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_REVIVE_BACKOFF_S", "float", 0.5,
+               "base seconds of the replica circuit-breaker reopen "
+               "backoff (serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_REVIVE_BACKOFF_CAP_S", "float", 15.0,
+               "cap on the replica circuit-breaker reopen backoff "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_SCALE_UP_BURN", "float", 1.0,
+               "sustained slo_burn_rate at/above which the serve tier "
+               "scales replica count up (serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_SLOW_P99_S", "float", None,
+               "p99 decode-step latency past which a replica is "
+               "classified slow (skipped by routing, hedge-eligible); "
+               "unset leaves only the watchdog straggler signal "
+               "(serve/controller.py)"))
 _register(Knob("RLA_TPU_SLO_DEADLINE_S", "float", None,
                "serve SLO: end-to-end deadline stamped on each request "
                "at admission; expired requests are shed typed "

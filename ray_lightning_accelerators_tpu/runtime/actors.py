@@ -47,6 +47,22 @@ from .watchdog import (HeartbeatChannel, WorkerBeat, WorkerWedged,
 
 _SENTINEL = b"__shutdown__"
 
+# worker-process side: this process's beat thread, installed by
+# _worker_main so in-process layers (the replica-level chaos seam in
+# serve/replicas.py) can freeze it without plumbing the object through
+# every dispatch signature
+_CURRENT_BEAT: Optional["WorkerBeat"] = None
+
+
+def freeze_current_heartbeat() -> None:
+    """Freeze THIS worker process's heartbeat thread (no-op on the
+    driver / when heartbeats are disabled).  A chaos ``hang`` injected
+    above the dispatch loop — e.g. inside a replica's serve-chunk path —
+    calls this so the hang reads as a frozen process to the watchdog,
+    not as a long-running dispatch."""
+    if _CURRENT_BEAT is not None:
+        _CURRENT_BEAT.freeze()
+
 
 def _worker_main(conn, env: Dict[str, str], rank: int = 0,
                  heartbeat: Optional[HeartbeatChannel] = None,
@@ -109,6 +125,8 @@ def _worker_main(conn, env: Dict[str, str], rank: int = 0,
     if heartbeat is not None and heartbeat_s > 0:
         beat = WorkerBeat(heartbeat, heartbeat_s)
         beat.start()
+        global _CURRENT_BEAT
+        _CURRENT_BEAT = beat
     # preemption notice handler (runtime/preemption.py), installed only
     # when a grace budget is configured: SIGTERM then flips a drain flag
     # the dispatched body polls (busy) or exits immediately (idle), so
@@ -533,6 +551,28 @@ class ActorPool:
         wedged ranks reaped so their futures fail ``WorkerWedged``."""
         from .watchdog import Watchdog
         return Watchdog(self, **kwargs).start()
+
+    def add_worker(self, env: Optional[Dict[str, str]] = None,
+                   rank: Optional[int] = None) -> Worker:
+        """Grow the pool by one LOCAL worker (the serve tier's scale-up
+        primitive, serve/controller.py).  The new worker gets the next
+        free rank (max existing + 1 — ranks are identity, so a rank
+        freed by ``drop`` is never reused within one pool lifetime) and
+        its own env overlay.  Agent-backed pools are not supported: a
+        remote scale-up needs placement the agent protocol doesn't
+        express yet."""
+        if self.workers and not isinstance(self.workers[0], Worker):
+            raise RuntimeError(
+                "add_worker supports local subprocess pools only "
+                "(agent-backed pools cannot place new workers)")
+        if rank is None:
+            rank = max((w.rank for w in self.workers), default=-1) + 1
+        w = Worker(rank, dict(env or {}), mp.get_context("spawn"))
+        self.workers.append(w)
+        log.warning("added worker rank %d; pool now %d rank(s) %s",
+                    rank, len(self.workers),
+                    [x.rank for x in self.workers])
+        return w
 
     def restart_dead(self, init_hook: Optional[Callable[[], None]] = None) \
             -> List[int]:

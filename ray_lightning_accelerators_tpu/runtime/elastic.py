@@ -39,13 +39,16 @@ Trainer.fit(ckpt_path="last")).
 from __future__ import annotations
 
 import inspect
-import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..analysis import knobs
 from ..telemetry import perf as perf_lib
 from ..telemetry import recorder as telemetry
+# the backoff schedule lives in utils/backoff.py (shared with the serve
+# tier's retry/revival loops); re-exported here so existing importers
+# (tests, downstream orchestration) keep working
+from ..utils.backoff import DEFAULT_BACKOFF_CAP_S, backoff_delay_s
 from ..utils.logging import log
 from . import preemption as preempt_lib
 from .actors import ActorPool
@@ -54,7 +57,9 @@ from .watchdog import Watchdog, WorkerWedged, wedge_timeout_from_env
 
 BACKOFF_BASE_ENV = "RLA_TPU_ELASTIC_BACKOFF_S"
 BACKOFF_CAP_ENV = "RLA_TPU_ELASTIC_BACKOFF_CAP_S"
-DEFAULT_BACKOFF_CAP_S = 60.0
+
+__all__ = ["ElasticResizeError", "ElasticRunner", "backoff_delay_s",
+           "DEFAULT_BACKOFF_CAP_S"]
 
 
 class ElasticResizeError(ValueError):
@@ -62,19 +67,6 @@ class ElasticResizeError(ValueError):
     divisibility contract (per-process batch over the new data-parallel
     size) breaks.  Typed so orchestration can tell "re-shard and go" from
     "this run cannot continue at this size"."""
-
-
-def backoff_delay_s(attempt: int, base_s: float,
-                    cap_s: float = DEFAULT_BACKOFF_CAP_S,
-                    rng: Callable[[], float] = random.random) -> float:
-    """Exponential backoff with half-jitter: ``min(cap, base * 2**(a-1))``
-    scaled by a uniform factor in [0.5, 1.0).  ``attempt`` is 1-based (the
-    first RETRY).  Jitter keeps a fleet of runners restarting off a sick
-    shared host from hot-looping it in lockstep."""
-    if base_s <= 0 or attempt < 1:
-        return 0.0
-    d = min(cap_s, base_s * (2.0 ** (attempt - 1)))
-    return d * (0.5 + 0.5 * rng())
 
 
 class ElasticRunner:

@@ -233,6 +233,12 @@ class Watchdog:
                  boot_grace_s: float = 120.0,
                  on_transition: Optional[
                      Callable[[int, str, str], None]] = None):
+        # the source is kept, not just a snapshot: an ActorPool that
+        # grows (serve scale-up, ActorPool.add_worker) or shrinks
+        # (ActorPool.drop) between polls is re-listed every sweep, so
+        # new ranks are supervised from their first poll and dropped
+        # ranks stop being classified
+        self._source = workers
         self.workers = list(getattr(workers, "workers", workers))
         if wedge_timeout_s is None:
             wedge_timeout_s = wedge_timeout_from_env()
@@ -337,6 +343,10 @@ class Watchdog:
     def poll_once(self) -> Dict[int, str]:
         """One classification sweep; reaps newly wedged ranks when
         ``auto_reap``.  Returns {rank: state}."""
+        # re-list the source pool: ranks added/dropped since the last
+        # sweep enter/leave supervision here (see __init__)
+        self.workers = list(getattr(self._source, "workers",
+                                    self._source))
         new_states: Dict[int, str] = {}
         to_reap: List[Tuple[Any, Dict[str, Any]]] = []
         for w in self.workers:

@@ -18,24 +18,31 @@ request-serving engine:
   p50/p95/p99/max via the profiler's reservoir percentiles;
 - **replicas**: N engine replicas on the existing ``ActorPool`` with
   watchdog supervision — a wedged replica is reaped and its in-flight
-  requests re-queued onto survivors, never lost or duplicated.
+  requests re-queued onto survivors, never lost or duplicated;
+- **controller**: the self-healing closed loop over the replica tier —
+  health/load-aware routing, retry budgets with shared exponential
+  backoff, hedged re-dispatch of a slow replica's oldest chunk,
+  circuit-breaker auto-revival, SLO-burn/occupancy autoscaling and
+  typed brownout shedding (``BrownoutShed``).
 
 Exactness is the contract: every response is token-identical to a
 standalone greedy ``GPT.generate()`` of the same prompt.
 """
 
-from .batcher import (AdmissionController, PoolExhausted, QueueFull,
-                      RequestRejected, ServeCancelled, ServeRequest,
-                      ServeResponse, blocks_for_request)
+from .batcher import (AdmissionController, BrownoutShed, PoolExhausted,
+                      QueueFull, RequestRejected, ServeCancelled,
+                      ServeRequest, ServeResponse, blocks_for_request)
+from .controller import ControllerConfig, ReplicaController
 from .engine import BlockAllocator, ServeEngine
 from .metrics import ServeMetrics
 from .replicas import ServeReplicas
 from .slo import DeadlineExceeded, SloPolicy, SloTracker
 
 __all__ = [
-    "AdmissionController", "PoolExhausted", "QueueFull",
+    "AdmissionController", "BrownoutShed", "PoolExhausted", "QueueFull",
     "RequestRejected", "ServeCancelled", "ServeRequest", "ServeResponse",
     "BlockAllocator", "ServeEngine", "ServeMetrics", "ServeReplicas",
+    "ControllerConfig", "ReplicaController",
     "blocks_for_request",
     "SloPolicy", "SloTracker", "DeadlineExceeded",
 ]

@@ -68,6 +68,26 @@ class PoolExhausted(QueueFull):
         self.total = total
 
 
+class BrownoutShed(QueueFull):
+    """Typed brownout: the replica tier is saturated — the queue is past
+    the controller's shed watermark and no scale-up headroom remains —
+    so the request is shed BEFORE the queue grows to its hard cap
+    (serve/controller.py).  Subclasses ``QueueFull`` because the client
+    contract is the same 429 analog: shed load, retry after responses
+    drain; the distinct type says the tier chose to degrade early
+    rather than queue into unbounded tail latency."""
+
+    def __init__(self, depth: int, watermark: int, limit: int):
+        RuntimeError.__init__(
+            self,
+            f"serve tier brownout: {depth} queued >= shed watermark "
+            f"{watermark} (hard cap {limit}) with no scale-up headroom; "
+            "retry after load drains")
+        self.depth = depth
+        self.watermark = watermark
+        self.limit = limit
+
+
 def blocks_for_request(prompt_len: int, max_new_tokens: int,
                        block_len: int, headroom: int = 0) -> int:
     """Worst-case KV blocks a request pins: enough to cover every
@@ -103,6 +123,11 @@ class ServeRequest:
     max_new_tokens: int
     t_submit: float             # monotonic, stamped at admission
     requeues: int = 0           # infra-failure re-admissions so far
+    # retry backoff (serve/controller.py): a requeued request is not
+    # dispatchable before this monotonic instant.  The requeue LANE
+    # holds its head until then — a retried request keeps its place in
+    # front of new admissions instead of losing it to the backoff
+    not_before: float = 0.0
     # absolute SLO deadline (monotonic; serve/slo.py), stamped ONCE at
     # admission when the controller carries a policy with deadline_s.
     # It rides the request object through requeue and replica
@@ -331,14 +356,20 @@ class AdmissionController:
             self._depth += 1
             self._cond.notify_all()
 
-    def requeue(self, req: ServeRequest, resp: ServeResponse) -> bool:
+    def requeue(self, req: ServeRequest, resp: ServeResponse,
+                delay_s: float = 0.0) -> bool:
         """Head-of-line re-admission after an infra failure (replica
         wedged/died mid-chunk).  Bypasses the depth cap — the request was
-        already admitted once.  Returns False (and fails the response
-        typed) when the controller is already closed."""
+        already admitted once.  ``delay_s`` stamps a retry backoff
+        (``not_before``): the lane holds until it expires, so the retry
+        keeps its head-of-line position while still backing off.
+        Returns False (and fails the response typed) when the controller
+        is already closed."""
         with self._cond:
             if not self._closed:
                 req.requeues += 1
+                req.not_before = (time.monotonic() + delay_s
+                                  if delay_s > 0 else 0.0)
                 self._requeue.append((req, resp))
                 self._depth += 1
                 self._cond.notify_all()
@@ -349,9 +380,15 @@ class AdmissionController:
         return False
 
     def pop(self) -> Optional[Tuple[ServeRequest, ServeResponse]]:
-        """Next request or None.  The requeue lane drains first."""
+        """Next request or None.  The requeue lane drains first; a lane
+        head still inside its retry backoff HOLDS the lane (returns
+        None) — a requeued request must re-dispatch before anything
+        newly admitted, so the backoff must not let later arrivals
+        overtake it."""
         with self._cond:
             if self._requeue:
+                if self._requeue[0][0].not_before > time.monotonic():
+                    return None
                 self._depth -= 1
                 return self._requeue.popleft()
             item = self._q.get_nowait()

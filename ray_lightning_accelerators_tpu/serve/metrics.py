@@ -50,7 +50,16 @@ class ServeMetrics:
                  "pool_exhausted", "prefix_lookups", "prefix_hits",
                  "prefix_hit_blocks", "speculative_requests",
                  "speculative_rounds", "speculative_tokens_accepted",
-                 "slo_violations", "slo_deadline_shed")
+                 "slo_violations", "slo_deadline_shed",
+                 # replica-tier resilience (serve/controller.py):
+                 # hedged = speculative re-dispatches of a slow
+                 # replica's oldest in-flight chunk; hedge_wins = the
+                 # hedge copy answered first; brownout_shed = typed
+                 # BrownoutShed rejections at the saturation watermark;
+                 # revived = circuit-breaker replica revivals;
+                 # scale_ups/scale_downs = autoscale replica count moves
+                 "hedged", "hedge_wins", "brownout_shed", "revived",
+                 "scale_ups", "scale_downs")
 
     # pool/HBM fields are GAUGES (live values, not monotone counters);
     # telemetry/registry.py keys its Prometheus type choice off this set

@@ -1,4 +1,5 @@
-"""N serve-engine replicas on the actor runtime, watchdog-supervised.
+"""N serve-engine replicas on the actor runtime, watchdog-supervised,
+with a self-healing controller (serve/controller.py).
 
 Each replica is a ``runtime.actors.Worker`` subprocess owning a full
 engine (weights + cache + driver loop) — the per-replica eager execution
@@ -6,7 +7,8 @@ model of veScale-style runtimes: the driver here is a thin router, not a
 participant in the math.  Requests flow driver -> replica as CHUNKS (one
 dispatch carries several requests, submitted to the replica's engine
 together so it continuous-batches them); responses flow back on the
-worker future.
+worker future, along with the engine's own metrics snapshot — the
+load/SLO signal the controller routes and scales on.
 
 Failure model (the reason this layer exists):
 
@@ -15,19 +17,27 @@ Failure model (the reason this layer exists):
   anything on its own — the pool's ``Watchdog`` reaps it from heartbeat
   staleness and the chunk future fails ``WorkerWedged``;
 - either way the chunk's unanswered requests are RE-QUEUED head-of-line
-  and complete on a surviving replica.  Responses are exactly-once by the
-  ``ServeResponse`` first-completion-wins contract, so a request is never
-  lost and never answered twice (``metrics`` proves the accounting).
+  (with an exponential-backoff ``not_before`` stamp, bounded by a per-
+  request retry budget) and complete on a surviving replica.  Responses
+  are exactly-once by the ``ServeResponse`` first-completion-wins
+  contract, so a request is never lost and never answered twice
+  (``metrics`` proves the accounting) — the same contract that makes
+  HEDGED re-dispatch of a slow replica's oldest chunk safe;
 - a worker-side ``RemoteError`` (the engine itself raised) is an
   APPLICATION failure: re-running it elsewhere would fail again, so it
   fails the requests typed instead of poisoning every replica in turn.
 
-Replicas that went down stay down (capacity degrades, correctness does
-not); ``revive(rank)`` restarts and re-initializes one explicitly.
+A replica that went down no longer stays down: its circuit breaker
+opens, backs off, half-open-probes and rejoins rotation
+(``ReplicaController.maybe_revive``); ``revive(rank)`` remains the
+manual path.  Sustained SLO burn / queue occupancy scales the tier up
+(``max_replicas``), sustained idle drains it back down, and a saturated
+tier with no headroom sheds typed (``BrownoutShed``).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -37,18 +47,32 @@ import numpy as np
 from ..runtime.actors import ActorPool, RemoteError
 from ..runtime.watchdog import WorkerWedged
 from ..utils.logging import log
-from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
-                      ServeResponse)
+from .batcher import (AdmissionController, BrownoutShed, ServeCancelled,
+                      ServeRequest, ServeResponse)
+from .controller import ControllerConfig, ReplicaController
 from .metrics import ServeMetrics
 
+# live-plane labels for groups sharing one process (telemetry/live.py)
+_GROUP_SEQ = itertools.count()
+
 # worker-process side: one engine per replica process, installed by
-# _replica_init (module-global so chunk dispatches find it)
+# _replica_init (module-global so chunk dispatches find it); the chaos
+# injector for replica-layer faults resolves lazily on the first chunk
 _ENGINE = None
+_CHAOS: Any = None  # None = unresolved, False = no replica faults
 
 
 def _replica_init(engine_factory: Callable[[], Any]) -> bool:
-    """Build and start this replica's engine (runs IN the worker)."""
+    """Build and start this replica's engine (runs IN the worker).
+    Installs the compile-guard listener first, so every chunk's stats
+    snapshot can carry an honest backend-compile count (the acceptance
+    tests pin zero steady-state recompiles per replica)."""
     global _ENGINE
+    try:
+        from ..analysis import compile_guard
+        compile_guard.install()
+    except Exception:
+        pass
     if _ENGINE is not None:
         _ENGINE.stop(cancel_active=True)
     _ENGINE = engine_factory()
@@ -56,24 +80,79 @@ def _replica_init(engine_factory: Callable[[], Any]) -> bool:
     return True
 
 
-def _replica_serve(items: List[Tuple[int, Any, int]]) -> List[
-        Tuple[int, Any]]:
+def _replica_chaos(rank: int):
+    """Replica-layer chaos injector (testing/chaos.py), resolved once
+    per worker process.  ``hang`` freezes this process's heartbeat so
+    the pool watchdog sees a frozen process, exactly like worker-layer
+    hangs."""
+    global _CHAOS
+    if _CHAOS is None:
+        from ..analysis import knobs
+        inj = False
+        if knobs.get_raw("RLA_TPU_CHAOS"):
+            from ..runtime.actors import freeze_current_heartbeat
+            from ..testing.chaos import ChaosInjector
+            inj = ChaosInjector.from_env(
+                rank, freeze_heartbeat=freeze_current_heartbeat,
+                layer="replica") or False
+        _CHAOS = inj
+    return _CHAOS or None
+
+
+def _engine_stats_snapshot() -> Dict[str, Any]:
+    snap = _ENGINE.stats()
+    try:
+        from ..analysis import compile_guard
+        snap["compile_count"] = compile_guard.compile_count()
+    except Exception:
+        pass
+    return snap
+
+
+def _replica_serve(rank: int, items: List[Tuple[int, Any, int]]
+                   ) -> Tuple[List[Tuple[int, Any]], Dict[str, Any]]:
     """Serve one chunk (runs IN the worker).  Submit EVERY request before
     waiting on any, so the engine joins them into shared decode steps —
     this is where driver-level chunking becomes replica-level continuous
-    batching."""
+    batching.  Returns ``(results, engine stats snapshot)`` — the stats
+    ride every chunk home so the controller's routing/autoscale signals
+    stay fresh without extra dispatches (which would also shift the
+    worker's chaos dispatch numbering)."""
     if _ENGINE is None:
         raise RuntimeError("replica engine not initialized")
+    chaos = _replica_chaos(rank)
+    if chaos is not None:
+        chaos.on_dispatch()  # may crash/hang/slow THIS chunk
     handles = [(rid, _ENGINE.submit(np.asarray(prompt, np.int32), n))
                for rid, prompt, n in items]
-    return [(rid, np.asarray(h.result())) for rid, h in handles]
+    results = [(rid, np.asarray(h.result())) for rid, h in handles]
+    return results, _engine_stats_snapshot()
+
+
+def _replica_stats() -> Dict[str, Any]:
+    """Engine metrics snapshot (runs IN the worker) — also the circuit
+    breaker's half-open probe dispatch."""
+    if _ENGINE is None:
+        raise RuntimeError("replica engine not initialized")
+    return _engine_stats_snapshot()
+
+
+def _replica_stop() -> bool:
+    """Graceful engine stop (runs IN the worker): the scale-down drain
+    path — admission is already fenced driver-side, in-flight slots
+    finish on the engine's own retire path."""
+    global _ENGINE
+    if _ENGINE is not None:
+        _ENGINE.stop(cancel_active=False)
+        _ENGINE = None
+    return True
 
 
 def _is_application_failure(exc: BaseException) -> bool:
     """Failure triage for a chunk dispatch: True when the DISPATCHED
     CODE failed deterministically (fail those requests, keep the replica
-    serving), False for infrastructure death (mark the replica down,
-    requeue onto survivors).
+    serving), False for infrastructure death (open the replica's
+    circuit, requeue onto survivors).
 
     Application = a ``RemoteError`` payload, or a typed exception
     ``runtime/wire.py`` rebuilt from a worker-raised payload
@@ -87,15 +166,8 @@ def _is_application_failure(exc: BaseException) -> bool:
             and not isinstance(exc, WorkerWedged))
 
 
-def _replica_stats() -> Dict[str, Any]:
-    """Engine metrics snapshot (runs IN the worker)."""
-    if _ENGINE is None:
-        raise RuntimeError("replica engine not initialized")
-    return _ENGINE.stats()
-
-
 class ServeReplicas:
-    """Router over ``num_replicas`` engine replicas with supervision.
+    """Self-healing router over ``num_replicas`` engine replicas.
 
     ``engine_factory``: zero-arg callable building a STARTABLE
     ``ServeEngine`` — it executes inside each worker process (ship numpy
@@ -103,19 +175,28 @@ class ServeReplicas:
     initializes).  ``chunk_size``: max requests per dispatch (the
     replica's engine batches the chunk).  ``wedge_timeout_s`` /
     ``heartbeat_s``: watchdog knobs, see runtime/watchdog.py.
-    ``max_requeues``: infra-failure retries per request before failing it
-    typed.
-    """
+    ``max_requeues``: infra-failure retries per request before failing
+    it typed (None = the ``RLA_TPU_SERVE_MAX_RETRIES`` knob, default 2).
+
+    ``controller``: a :class:`~.controller.ControllerConfig` (or None
+    for the knob-backed default) configuring routing, hedging, the
+    circuit breaker, autoscaling and brownout — see serve/controller.py.
+    ``scale_env``: env overlay for autoscaled replicas (defaults to the
+    heartbeat knob only — chaos/port overlays of the initial replicas
+    are deliberately NOT inherited)."""
 
     def __init__(self, engine_factory: Callable[[], Any],
                  num_replicas: int = 2, *, queue_depth: int = 256,
                  max_total_len: Optional[int] = None,
-                 chunk_size: int = 4, max_requeues: int = 2,
+                 chunk_size: int = 4,
+                 max_requeues: Optional[int] = None,
                  heartbeat_s: Optional[float] = None,
                  wedge_timeout_s: Optional[float] = None,
                  supervise: bool = True,
                  env_per_worker: Optional[List[Dict[str, str]]] = None,
-                 idle_poll_s: float = 0.02):
+                 idle_poll_s: float = 0.02,
+                 controller: Optional[ControllerConfig] = None,
+                 scale_env: Optional[Dict[str, str]] = None):
         envs = [dict(e) for e in (env_per_worker
                                   or [{} for _ in range(num_replicas)])]
         if heartbeat_s is not None:
@@ -123,18 +204,19 @@ class ServeReplicas:
                 e.setdefault("RLA_TPU_WORKER_HEARTBEAT_S",
                              str(heartbeat_s))
         self.chunk_size = max(1, chunk_size)
-        self.max_requeues = max_requeues
+        self.queue_depth = queue_depth
         self.metrics = ServeMetrics()
         self.batcher = AdmissionController(queue_depth=queue_depth,
                                            max_total_len=max_total_len)
         self.metrics.bind_queue(lambda: self.batcher.depth)
         self._idle_poll_s = idle_poll_s
-        self._lock = threading.Lock()
-        self._down: set = set()
-        self._busy: set = set()
-        self._next_rank = 0
         self._stop = threading.Event()
         self._engine_factory = engine_factory
+        self._scale_env = dict(scale_env or {})
+        if heartbeat_s is not None:
+            self._scale_env.setdefault("RLA_TPU_WORKER_HEARTBEAT_S",
+                                       str(heartbeat_s))
+        self._live_label: Optional[str] = None
         self.pool = ActorPool(num_replicas, env_per_worker=envs)
         try:
             for f in self.pool.execute_all(_replica_init, engine_factory):
@@ -144,16 +226,40 @@ class ServeReplicas:
         except BaseException:
             self.pool.kill()
             raise
+        cfg = controller or ControllerConfig.from_env()
+        self.controller = ReplicaController(self, cfg)
+        self.max_requeues = (max_requeues if max_requeues is not None
+                             else cfg.max_retries)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="rla-tpu-serve-dispatch")
         self._dispatcher.start()
+        self.controller.start()
+        # live telemetry plane (telemetry/live.py): with
+        # RLA_TPU_METRICS_PORT configured, the group's tier metrics and
+        # the controller's per-replica table join the driver process's
+        # /metrics + /statusz while the tier serves
+        from ..telemetry import live as live_lib
+        srv = live_lib.maybe_start_from_env()
+        if srv is not None:
+            self._live_label = f"replicas{next(_GROUP_SEQ)}"
+            srv.sources.add_serve(self._live_label, self.metrics)
+            srv.sources.bind_replica_controller(self.controller)
 
     # ------------------------------------------------------------------ #
     # Client surface                                                     #
     # ------------------------------------------------------------------ #
     def submit(self, prompt: Any, max_new_tokens: int) -> ServeResponse:
         from .batcher import QueueFull, RequestRejected
+        shed = self.controller.should_shed()
+        if shed is not None:
+            depth, watermark, cap = shed
+            self.metrics.inc("rejected")
+            self.metrics.inc("brownout_shed")
+            from ..telemetry import recorder as telemetry
+            telemetry.emit("serve_brownout_shed", depth=depth,
+                           watermark=watermark)
+            raise BrownoutShed(depth, watermark, cap)
         try:
             resp = self.batcher.submit(prompt, max_new_tokens)
         except (QueueFull, RequestRejected):
@@ -167,28 +273,33 @@ class ServeReplicas:
     def stats(self) -> Dict[str, Any]:
         out = self.metrics.snapshot()
         out["replicas"] = len(self.pool)
-        with self._lock:
-            out["replicas_down"] = sorted(self._down)
+        out["replicas_down"] = self.controller.down_ranks()
+        out["controller"] = self.controller.snapshot()
         if self.watchdog is not None:
             out["supervision"] = self.watchdog.report()
         return out
 
     def replica_stats(self, rank: int) -> Dict[str, Any]:
         """A live replica's own engine metrics (proves in-replica
-        batching: its ``steps_batch_gt1`` counts shared decode steps)."""
-        return self.pool.workers[rank].execute(_replica_stats).result()
+        batching: its ``steps_batch_gt1`` counts shared decode steps;
+        carries ``compile_count`` for steady-state recompile pins)."""
+        w = self._worker(rank)
+        if w is None:
+            raise RuntimeError(
+                f"replica {rank} is not in the pool (retired by a "
+                "scale-down, or never existed)")
+        return w.execute(_replica_stats).result()
 
     def revive(self, rank: int) -> None:
-        """Restart a downed replica and re-initialize its engine."""
-        w = self.pool.workers[rank]
-        w.restart()
-        w.execute(_replica_init, self._engine_factory).result()
-        with self._lock:
-            self._down.discard(rank)
-            self._busy.discard(rank)
+        """Restart a downed replica and re-initialize its engine NOW —
+        the manual path; the controller's circuit breaker does the same
+        automatically after its backoff."""
+        self._revive_replica(rank)
+        self.controller.note_revived(rank)
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.controller.stop()
         self.batcher.kick()
         self._dispatcher.join(timeout=30)
         n = self.batcher.shutdown()
@@ -196,6 +307,15 @@ class ServeReplicas:
             self.metrics.inc("cancelled", n)
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self._live_label is not None:
+            from ..telemetry import live as live_lib
+            srv = live_lib.get_server()
+            if srv is not None:
+                srv.sources.remove_serve(self._live_label)
+                # only OUR controller: a sibling group that bound after
+                # us must keep its table on the export
+                srv.sources.unbind_replica_controller(self.controller)
+            self._live_label = None
         self.pool.shutdown()
 
     def __enter__(self) -> "ServeReplicas":
@@ -205,104 +325,197 @@ class ServeReplicas:
         self.shutdown()
 
     # ------------------------------------------------------------------ #
-    # Dispatch                                                           #
+    # Replica mechanics (the controller's hands)                         #
     # ------------------------------------------------------------------ #
-    def _pick_replica(self) -> Optional[int]:
-        """Round-robin over live, idle replicas (round-robin spreads load
-        so a hang anywhere is actually exercised, not avoided)."""
-        n = len(self.pool)
-        with self._lock:
-            for off in range(n):
-                rank = (self._next_rank + off) % n
-                if rank in self._down or rank in self._busy:
-                    continue
-                if not self.pool.workers[rank].is_alive:
-                    self._down.add(rank)
-                    continue
-                self._busy.add(rank)
-                self._next_rank = (rank + 1) % n
-                return rank
+    def _worker(self, rank: int) -> Any:
+        """Rank-keyed lookup: after scale-downs the workers list is no
+        longer index-aligned with ranks."""
+        for w in self.pool.workers:
+            if w.rank == rank:
+                return w
         return None
 
+    def _revive_replica(self, rank: int) -> Dict[str, Any]:
+        """Restart + re-init one replica and PROBE it (one stats round
+        trip) before it may rejoin rotation; raises on any failure.
+        Each worker generation re-publishes its telemetry portfile and
+        heartbeat channel from worker boot (runtime/actors._worker_main
+        + telemetry/live.py), so a revived replica reappears in
+        ClusterView/rla_top without extra plumbing."""
+        w = self._worker(rank)
+        if w is None:
+            raise RuntimeError(f"replica {rank} is not in the pool")
+        w.restart()
+        w.execute(_replica_init, self._engine_factory).result(
+            timeout=self.controller.cfg.probe_timeout_s)
+        return w.execute(_replica_stats).result(
+            timeout=self.controller.cfg.probe_timeout_s)
+
+    def _add_replica(self) -> int:
+        """Scale-up: spawn one more replica worker and init its engine
+        (blocking; runs in the controller tick thread)."""
+        w = self.pool.add_worker(env=dict(self._scale_env))
+        try:
+            w.execute(_replica_init, self._engine_factory).result()
+        except BaseException:
+            try:
+                self.pool.drop([w.rank])
+            except BaseException:
+                pass
+            raise
+        return w.rank
+
+    def _retire_replica(self, rank: int) -> None:
+        """Scale-down of a DRAINED replica: stop its engine gracefully,
+        then the worker, then forget the rank (survivors keep their
+        rank identity — ``ActorPool.drop`` semantics)."""
+        w = self._worker(rank)
+        if w is None:
+            return
+        try:
+            w.execute(_replica_stop).result(timeout=30)
+        except BaseException as e:
+            log.warning("graceful engine stop of replica %d failed: %s",
+                        rank, e)
+        self.pool.drop([rank])
+
+    # ------------------------------------------------------------------ #
+    # Dispatch                                                           #
+    # ------------------------------------------------------------------ #
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
-            if not self.batcher.wait_for_work(self._idle_poll_s):
-                continue
-            with self._lock:
-                all_down = len(self._down) >= len(self.pool)
-            if all_down:
-                # no capacity will ever come back on its own: fail the
-                # queue typed rather than hang every caller forever
-                for req, resp in iter(self.batcher.pop, None):
-                    if resp._fail(ServeCancelled(
-                            f"request {req.request_id}: every replica is "
-                            "down")):
-                        self.metrics.inc("failed")
+            try:
+                self._dispatch_once()
+            except Exception as e:  # a policy bug must not kill dispatch
+                log.error("serve dispatch iteration failed: %s", e)
                 time.sleep(self._idle_poll_s)
-                continue
-            rank = self._pick_replica()
-            if rank is None:
-                time.sleep(self._idle_poll_s)
-                continue
-            chunk: List[Tuple[ServeRequest, ServeResponse]] = []
-            while len(chunk) < self.chunk_size:
-                item = self.batcher.pop()
-                if item is None:
-                    break
-                chunk.append(item)
-            if not chunk:
-                with self._lock:
-                    self._busy.discard(rank)
-                continue
-            items = [(req.request_id, req.prompt, req.max_new_tokens)
-                     for req, _ in chunk]
-            fut = self.pool.workers[rank].execute(_replica_serve, items)
-            fut.add_done_callback(
-                lambda f, r=rank, c=chunk: self._on_chunk_done(r, c, f))
 
-    def _on_chunk_done(self, rank: int,
+    def _dispatch_once(self) -> None:
+        if not self.batcher.wait_for_work(self._idle_poll_s):
+            return
+        if not self.controller.serving_possible():
+            # no capacity will ever come back on its own (every
+            # circuit open and auto-revive disabled): fail the
+            # queue typed rather than hang every caller forever
+            for req, resp in iter(self.batcher.pop, None):
+                if resp._fail(ServeCancelled(
+                        f"request {req.request_id}: every replica is "
+                        "down and auto-revive is disabled")):
+                    self.metrics.inc("failed")
+            time.sleep(self._idle_poll_s)
+            return
+        rank = self.controller.route()
+        if rank is None:
+            time.sleep(self._idle_poll_s)
+            return
+        chunk: List[Tuple[ServeRequest, ServeResponse]] = []
+        while len(chunk) < self.chunk_size:
+            item = self.batcher.pop()
+            if item is None:
+                break
+            if item[1].done():
+                # a requeued request a hedge copy already answered:
+                # nothing left to serve — dropping it here saves a
+                # whole wasted prefill+decode on a replica
+                continue
+            chunk.append(item)
+        if not chunk:
+            # nothing dispatchable right now (empty queue race or a
+            # requeue-lane head still inside its retry backoff)
+            time.sleep(self._idle_poll_s / 2)
+            return
+        self._dispatch(rank, chunk)
+
+    def _dispatch(self, rank: int,
+                  chunk: List[Tuple[ServeRequest, ServeResponse]],
+                  hedge_of: Optional[Tuple[int, int]] = None) -> None:
+        """Ship one chunk to ``rank`` (primary dispatch, or a HEDGE
+        copy when ``hedge_of`` names the slow original)."""
+        chunk_id = self.controller.on_dispatch(rank, chunk,
+                                               hedge_of=hedge_of)
+        items = [(req.request_id, req.prompt, req.max_new_tokens)
+                 for req, _ in chunk]
+        w = self._worker(rank)
+        if w is None:
+            fut = None
+        else:
+            fut = w.execute(_replica_serve, rank, items)
+        if fut is None:
+            exc = RuntimeError(f"replica {rank} left the pool before "
+                               "dispatch")
+            self.controller.note_infra_failure(rank, chunk_id, exc)
+            for req, resp in chunk:
+                self._requeue_or_fail(req, resp, exc, rank)
+            return
+        fut.add_done_callback(
+            lambda f, r=rank, cid=chunk_id, c=chunk, h=hedge_of:
+            self._on_chunk_done(r, cid, c, h, f))
+
+    def _on_chunk_done(self, rank: int, chunk_id: int,
                        chunk: List[Tuple[ServeRequest, ServeResponse]],
+                       hedge_of: Optional[Tuple[int, int]],
                        fut) -> None:
         """Runs on the worker's collector thread: settle or re-queue."""
-        with self._lock:
-            self._busy.discard(rank)
         exc = fut.exception()
         if exc is None:
-            results = dict(fut.result())
+            results, stats = fut.result()
+            self.controller.note_success(rank, chunk_id, stats)
+            results = dict(results)
+            now = time.monotonic()
+            hedge_won = False
             for req, resp in chunk:
                 tokens = results.get(req.request_id)
                 if tokens is None:
                     self._requeue_or_fail(req, resp, RuntimeError(
                         f"replica {rank} returned no result for request "
-                        f"{req.request_id}"))
+                        f"{req.request_id}"), rank)
                 elif resp._complete(tokens):
                     self.metrics.inc("completed")
+                    # tier-level TTFT: a chunk returns the FULL
+                    # sequence, so submit -> response is the finest
+                    # first-token signal the driver can observe (it
+                    # upper-bounds the replica's own TTFT and is what
+                    # a tier client actually waits)
+                    if resp.ttft_s is None:
+                        resp.ttft_s = now - req.t_submit
+                        self.metrics.observe_ttft(resp.ttft_s)
+                    hedge_won = True
+            if hedge_of is not None and hedge_won:
+                # the hedge copy answered before the slow original —
+                # first-completion-wins proves each response still
+                # resolved exactly once.  Counted per hedge CHUNK, the
+                # same unit as "hedged", so hedge_wins/hedged is a rate
+                self.metrics.inc("hedge_wins")
             return
         if _is_application_failure(exc):
             # application failure: deterministic, don't poison survivors
+            self.controller.note_app_failure(rank, chunk_id)
             log.error("replica %d failed a chunk application-side: %s",
                       rank, exc)
             for req, resp in chunk:
                 if resp._fail(exc):
                     self.metrics.inc("failed")
             return
-        # infra failure: wedged (watchdog reap) or died -- requeue
-        with self._lock:
-            self._down.add(rank)
+        # infra failure: wedged (watchdog reap) or died — open the
+        # circuit and requeue; the breaker revives it later
+        self.controller.note_infra_failure(rank, chunk_id, exc)
         if isinstance(exc, WorkerWedged):
             self.metrics.inc("wedge_events")
-        log.warning("replica %d lost mid-chunk (%s); re-queuing %d "
-                    "request(s)", rank, type(exc).__name__, len(chunk))
+        log.warning("replica %d lost mid-chunk (%s); recovering %d "
+                    "request(s) (requeue unless a hedge already "
+                    "answered)", rank, type(exc).__name__, len(chunk))
         for req, resp in chunk:
-            self._requeue_or_fail(req, resp, exc)
+            self._requeue_or_fail(req, resp, exc, rank)
 
     def _requeue_or_fail(self, req: ServeRequest, resp: ServeResponse,
-                         exc: BaseException) -> None:
+                         exc: BaseException,
+                         rank: Optional[int] = None) -> None:
         if resp.done():
             return
         if req.requeues >= self.max_requeues:
             if resp._fail(exc):
                 self.metrics.inc("failed")
             return
-        if self.batcher.requeue(req, resp):
+        delay = self.controller.charge_retry(rank, req)
+        if self.batcher.requeue(req, resp, delay_s=delay):
             self.metrics.inc("requeued")

@@ -171,6 +171,9 @@ class LiveSources:
         self._serve: "Dict[str, Any]" = {}
         self._slo: "Dict[str, Any]" = {}
         self._cluster_view: Any = None
+        # serve replica-tier controller (serve/controller.py): its
+        # per-replica table rides /statusz and the replica gauge family
+        self._replica_controller: Any = None
 
     # -- binds ---------------------------------------------------------- #
     def bind_trainer(self, trainer: Any) -> None:
@@ -191,6 +194,26 @@ class LiveSources:
     def bind_cluster_view(self, view: Any) -> None:
         with self._lock:
             self._cluster_view = view
+
+    def bind_replica_controller(self, controller: Any) -> None:
+        """Wire (or, with None, unwire) a ``ReplicaController`` so the
+        serve tier's per-replica state/load table is scrapeable live
+        (``/statusz`` ``replica_controller`` +
+        ``rla_tpu_serve_replica_*`` gauges on ``/metrics``).  One
+        controller table per process export: with several
+        ``ServeReplicas`` groups alive the most recently bound wins —
+        use ``unbind_replica_controller`` on teardown so one group's
+        shutdown cannot evict another's still-live table."""
+        with self._lock:
+            self._replica_controller = controller
+
+    def unbind_replica_controller(self, controller: Any) -> None:
+        """Remove ``controller`` from the export ONLY if it is the one
+        currently bound (a shut-down group must not unbind a sibling
+        group that bound after it)."""
+        with self._lock:
+            if self._replica_controller is controller:
+                self._replica_controller = None
 
     def _bound(self):
         with self._lock:
@@ -265,6 +288,13 @@ class LiveSources:
                 pass
         for label, m in serve.items():
             reg.add_serve(m, rank=label)
+        with self._lock:
+            rc = self._replica_controller
+        if rc is not None:
+            try:
+                reg.add_replica_controller(rc.snapshot())
+            except Exception as e:  # a scrape must degrade, never 500
+                log.warning("replica-controller export failed: %s", e)
         reg.add_rank_status(self.rank_label, self.rank_status())
         reg.add_scalar("events_per_second",
                        recorder_lib.get_recorder().events_per_second())
@@ -313,6 +343,13 @@ class LiveSources:
         if slo:
             out["slo"] = {label: t.snapshot()
                           for label, t in slo.items()}
+        with self._lock:
+            rc = self._replica_controller
+        if rc is not None:
+            try:
+                out["replica_controller"] = rc.snapshot()
+            except Exception:
+                pass
         if cv is not None:
             out["cluster"] = cv.last_view()
         return out
@@ -341,6 +378,13 @@ class LiveSources:
         if serve:
             out["serve"] = {label: m.snapshot()
                             for label, m in serve.items()}
+        with self._lock:
+            rc = self._replica_controller
+        if rc is not None:
+            try:
+                out["replica_controller"] = rc.snapshot()
+            except Exception:
+                pass
         try:
             from ..analysis import compile_guard
             out["compile"] = compile_guard.compile_count()

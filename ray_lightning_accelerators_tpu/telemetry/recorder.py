@@ -99,6 +99,11 @@ EVENT_KINDS = frozenset({
     # while it was still queued (family "deadline" = shed before
     # prefill, typed DeadlineExceeded)
     "slo_violation",
+    # serve replica controller (serve/controller.py): per-replica
+    # state transitions (ok/slow/open/draining), hedged re-dispatch,
+    # circuit-breaker revival, autoscale moves and typed brownout sheds
+    "serve_replica_state", "serve_hedge", "serve_revive",
+    "serve_scale_up", "serve_scale_down", "serve_brownout_shed",
 })
 
 

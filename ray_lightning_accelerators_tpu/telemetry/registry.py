@@ -67,6 +67,8 @@ class MetricsRegistry:
         self._perf: Dict[str, Dict[str, Any]] = {}
         # live-plane per-rank status rows (telemetry/live.py)
         self._ranks: Dict[str, Dict[str, Any]] = {}
+        # serve replica-controller snapshot (serve/controller.py)
+        self._replica_controller: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def _label(rank: Any) -> str:
@@ -131,6 +133,18 @@ class MetricsRegistry:
         if status:
             self._ranks[self._label(rank)] = dict(status)
 
+    def add_replica_controller(self, snapshot: Any) -> None:
+        """The serve tier's :class:`~..serve.controller
+        .ReplicaController` snapshot (object or its ``snapshot()``
+        dict): per-replica state/load rows rendered as the
+        ``rla_tpu_serve_replica_*`` gauge family (one sample per
+        replica label) plus tier-level queue/brownout gauges."""
+        if snapshot is None:
+            return
+        if hasattr(snapshot, "snapshot"):
+            snapshot = snapshot.snapshot()
+        self._replica_controller = dict(snapshot)
+
     # -- perf-observatory ledgers (telemetry/perf.py) ------------------- #
     @staticmethod
     def _snap(obj: Any) -> Dict[str, Any]:
@@ -181,6 +195,8 @@ class MetricsRegistry:
         }
         if self._ranks:
             out["ranks"] = {k: dict(v) for k, v in self._ranks.items()}
+        if self._replica_controller:
+            out["replica_controller"] = dict(self._replica_controller)
         if self._perf:
             out["perf"] = {k: dict(v) for k, v in self._perf.items()}
         if self._extra:
@@ -271,6 +287,47 @@ class MetricsRegistry:
                 val = row.get(key)
                 if isinstance(val, (int, float)):
                     add(fam, val, f'{{rank="{rank}"}}', mtype="gauge")
+        # serve replica-controller rows (serve/controller.py): one
+        # sample per replica label, key-major per family; monotone
+        # per-replica tallies are counters, load/health levels gauges
+        rc = self._replica_controller
+        if rc:
+            replicas = sorted((rc.get("replicas") or {}).items(),
+                              key=lambda kv: kv[0])
+            add("rla_tpu_serve_replica_count", len(replicas),
+                mtype="gauge")
+            for key, kind in (("inflight_requests", "gauge"),
+                              ("inflight_chunks", "gauge"),
+                              ("slo_burn", "gauge"),
+                              ("p99_step_ms", "gauge"),
+                              ("dispatched_chunks", "counter"),
+                              ("completed_chunks", "counter"),
+                              ("infra_failures", "counter"),
+                              ("app_failures", "counter"),
+                              ("retries", "counter"),
+                              ("hedges", "counter"),
+                              ("revivals", "counter")):
+                name = f"rla_tpu_serve_replica_{_prom_name(key)}"
+                if kind == "counter":
+                    name += "_total"
+                for label, row in replicas:
+                    val = row.get(key)
+                    if isinstance(val, (int, float)):
+                        add(name, val, f'{{replica="{label}"}}',
+                            mtype=kind)
+            # state one-hot: dashboards key on the label pair
+            for label, row in replicas:
+                state = row.get("state")
+                if state:
+                    add("rla_tpu_serve_replica_state", 1,
+                        f'{{replica="{label}",'
+                        f'state="{_prom_name(state)}"}}',
+                        mtype="gauge")
+            for key in ("queue_depth", "queue_cap",
+                        "brownout_watermark", "max_burn"):
+                if isinstance(rc.get(key), (int, float)):
+                    add(f"rla_tpu_serve_tier_{_prom_name(key)}",
+                        rc[key], mtype="gauge")
         # perf-observatory ledgers: phase seconds, HBM pools, goodput —
         # each family key-major like the serve block (exposition format
         # forbids interleaved families)

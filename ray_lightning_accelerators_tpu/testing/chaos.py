@@ -11,6 +11,12 @@ Syntax (comma-separated faults)::
 
     RLA_TPU_CHAOS=crash@rank1:step3,hang@rank0,slow@all:2.5
 
+Replica-layer faults (serve tier, honored inside
+``serve.replicas._replica_serve`` rather than the worker dispatch
+loop)::
+
+    RLA_TPU_CHAOS=crash@replica0:chunk2,hang@replica1:chunk3:once,slow@replica0:1.5
+
 ``kind@target[:qualifier...]`` where
 
 - kind: ``crash`` (``os._exit`` with exit code 43), ``hang`` (freeze the
@@ -26,7 +32,12 @@ Syntax (comma-separated faults)::
   respawn of that rank dies at boot, so ``pool.restart_dead()`` can
   never bring it back -- the permanently lost host that forces an
   elastic scale-down);
-- target: ``rankN`` or ``all``;
+- target: ``rankN`` or ``all`` (worker layer), or ``replicaN`` (replica
+  layer: the fault fires inside the replica's SERVE CHUNK path, counted
+  per chunk via the ``chunkK`` qualifier -- only ``crash``/``hang``/
+  ``slow`` make sense there; ``hang`` freezes the worker's heartbeat so
+  the pool watchdog sees a frozen process, exactly like the worker-layer
+  kind);
 - qualifiers: ``stepN`` -- fire on the Nth dispatch of the worker
   process's lifetime (1-based; crash/hang/preempt/lost default to step
   1, slow defaults to every dispatch); a float -- the delay for
@@ -59,6 +70,12 @@ CHAOS_NS_ENV = "RLA_TPU_CHAOS_NS"
 CHAOS_EXIT_CODE = 43
 LOST_EXIT_CODE = 44
 _KINDS = ("crash", "hang", "slow", "preempt", "lost")
+# faults that make sense at the replica serve-chunk layer: a replica is
+# a full process, so preempt/lost stay worker-layer kinds
+_REPLICA_KINDS = ("crash", "hang", "slow")
+
+LAYER_WORKER = "worker"
+LAYER_REPLICA = "replica"
 
 
 @dataclass(frozen=True)
@@ -68,6 +85,10 @@ class ChaosFault:
     step: Optional[int]  # None = every dispatch (slow) / step 1 (crash|hang)
     delay_s: Optional[float] = None  # slow only
     once: bool = False
+    # which injection seam honors this fault: "worker" = the dispatch
+    # loop in runtime/actors._worker_main (step = dispatch index),
+    # "replica" = serve.replicas._replica_serve (step = chunk index)
+    layer: str = LAYER_WORKER
 
     def matches(self, rank: int, step: int) -> bool:
         if self.rank is not None and self.rank != rank:
@@ -79,10 +100,14 @@ class ChaosFault:
         return True if self.kind == "slow" else step == 1
 
     def token(self, rank: int) -> str:
-        """Stable per-rank claim key for ``once`` semantics."""
-        tgt = "all" if self.rank is None else f"rank{self.rank}"
+        """Stable per-rank claim key for ``once`` semantics (layer-
+        prefixed for replica faults so a replica chunk claim can never
+        collide with a worker dispatch claim)."""
+        prefix = "replica" if self.layer == LAYER_REPLICA else "rank"
+        tgt = "all" if self.rank is None else f"{prefix}{self.rank}"
         step = "any" if self.step is None else f"step{self.step}"
-        return f"{self.kind}-{tgt}-{step}-r{rank}"
+        tok = f"{self.kind}-{tgt}-{step}-r{rank}"
+        return tok if self.layer == LAYER_WORKER else f"{self.layer}-{tok}"
 
 
 def parse_chaos(spec: str) -> List[ChaosFault]:
@@ -99,14 +124,23 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
                 f"{_KINDS}")
         bits = target_q.split(":")
         target = bits[0]
+        layer = LAYER_WORKER
         if target == "all":
             rank = None
         elif target.startswith("rank") and target[4:].isdigit():
             rank = int(target[4:])
+        elif target.startswith("replica") and target[7:].isdigit():
+            rank = int(target[7:])
+            layer = LAYER_REPLICA
+            if kind not in _REPLICA_KINDS:
+                raise ValueError(
+                    f"chaos fault {part!r}: replica-layer faults support "
+                    f"{_REPLICA_KINDS} only (preempt/lost are whole-"
+                    "process kinds — target the worker with 'rankN')")
         else:
             raise ValueError(
-                f"chaos fault {part!r}: target must be 'rankN' or 'all', "
-                f"got {target!r}")
+                f"chaos fault {part!r}: target must be 'rankN', "
+                f"'replicaN' or 'all', got {target!r}")
         step: Optional[int] = None
         delay: Optional[float] = None
         once = False
@@ -114,18 +148,31 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
             if q == "once":
                 once = True
             elif q.startswith("step") and q[4:].isdigit():
+                if layer == LAYER_REPLICA:
+                    raise ValueError(
+                        f"chaos fault {part!r}: replica faults count "
+                        "serve CHUNKS — use 'chunkN', not 'stepN'")
                 step = int(q[4:])
                 if step < 1:
                     raise ValueError(
                         f"chaos fault {part!r}: steps are 1-based")
+            elif q.startswith("chunk") and q[5:].isdigit():
+                if layer != LAYER_REPLICA:
+                    raise ValueError(
+                        f"chaos fault {part!r}: 'chunkN' only applies to "
+                        "replica-layer targets ('replicaN')")
+                step = int(q[5:])
+                if step < 1:
+                    raise ValueError(
+                        f"chaos fault {part!r}: chunks are 1-based")
             else:
                 try:
                     delay = float(q)
                 except ValueError:
                     raise ValueError(
                         f"chaos fault {part!r}: unknown qualifier {q!r} "
-                        "(expected 'stepN', 'once', or a float delay)"
-                    ) from None
+                        "(expected 'stepN'/'chunkN', 'once', or a float "
+                        "delay)") from None
         if kind == "slow" and delay is None:
             raise ValueError(
                 f"chaos fault {part!r}: 'slow' needs a float delay "
@@ -133,7 +180,8 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
         if kind != "slow" and delay is not None:
             raise ValueError(
                 f"chaos fault {part!r}: only 'slow' takes a delay")
-        faults.append(ChaosFault(kind, rank, step, delay, once))
+        faults.append(ChaosFault(kind, rank, step, delay, once,
+                                 layer=layer))
     return faults
 
 
@@ -143,23 +191,32 @@ class ChaosInjector:
     ``freeze_heartbeat``: callable stopping the worker's beat thread
     (``WorkerBeat.freeze``) so a ``hang`` looks like a frozen process to
     the watchdog, not a long dispatch.
+
+    ``layer`` selects which faults of the spec this injector honors:
+    the worker dispatch loop builds a ``"worker"`` injector (steps =
+    dispatches), the serve replica layer builds a ``"replica"`` one
+    (steps = serve chunks) — one spec can carry both kinds and each
+    seam only fires its own.
     """
 
     def __init__(self, faults: List[ChaosFault], rank: int,
                  freeze_heartbeat: Optional[Callable[[], None]] = None,
-                 ns_dir: Optional[str] = None):
-        self.faults = faults
+                 ns_dir: Optional[str] = None,
+                 layer: str = LAYER_WORKER):
+        self.layer = layer
+        self.faults = [f for f in faults if f.layer == layer]
         self.rank = rank
         self.freeze_heartbeat = freeze_heartbeat
         self.ns_dir = ns_dir
         self._step = 0
-        if any(f.once or f.kind == "lost" for f in faults) and not ns_dir:
+        if any(f.once or f.kind == "lost" for f in self.faults) \
+                and not ns_dir:
             raise ValueError(
                 f"chaos 'once' and 'lost' faults need {CHAOS_NS_ENV} set "
                 "to a directory (the cross-restart claim store)")
         # a rank whose 'lost' fault already fired is a gone host: every
         # respawned generation dies at boot, before serving any dispatch
-        for f in faults:
+        for f in self.faults:
             if (f.kind == "lost"
                     and (f.rank is None or f.rank == rank)
                     and os.path.exists(self._lost_marker(f))):
@@ -167,13 +224,14 @@ class ChaosInjector:
 
     @classmethod
     def from_env(cls, rank: int,
-                 freeze_heartbeat: Optional[Callable[[], None]] = None
-                 ) -> Optional["ChaosInjector"]:
+                 freeze_heartbeat: Optional[Callable[[], None]] = None,
+                 layer: str = LAYER_WORKER) -> Optional["ChaosInjector"]:
         spec = knobs.get_str(CHAOS_ENV, "")
         if not spec:
             return None
-        return cls(parse_chaos(spec), rank, freeze_heartbeat,
-                   knobs.get_raw(CHAOS_NS_ENV) or None)
+        inj = cls(parse_chaos(spec), rank, freeze_heartbeat,
+                  knobs.get_raw(CHAOS_NS_ENV) or None, layer=layer)
+        return inj if inj.faults else None
 
     def _lost_marker(self, fault: ChaosFault) -> str:
         """Persistent 'host gone' marker path for a lost fault on THIS

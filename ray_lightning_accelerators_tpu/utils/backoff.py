@@ -1,0 +1,37 @@
+"""Shared exponential backoff with half-jitter.
+
+One backoff implementation for every retry layer in the package —
+``ElasticRunner``'s between-attempt restarts (runtime/elastic.py) and
+the serve tier's request-retry / replica-revival schedules
+(serve/controller.py) — so their math can never drift apart.  The
+sequence is pinned by test: ``min(cap, base * 2**(attempt-1))`` scaled
+by a uniform factor in ``[0.5, 1.0)``.
+
+Half-jitter rather than full jitter: the delay never drops below half
+the deterministic schedule, so a retry loop keeps its exponential
+spacing guarantee while a fleet of retriers restarting off one sick
+shared host still decorrelates instead of hot-looping it in lockstep.
+
+Dependency leaf (stdlib only): runtime and serve both import it, never
+the reverse.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+DEFAULT_BACKOFF_CAP_S = 60.0
+
+
+def backoff_delay_s(attempt: int, base_s: float,
+                    cap_s: float = DEFAULT_BACKOFF_CAP_S,
+                    rng: Callable[[], float] = random.random) -> float:
+    """Exponential backoff with half-jitter: ``min(cap, base * 2**(a-1))``
+    scaled by a uniform factor in [0.5, 1.0).  ``attempt`` is 1-based (the
+    first RETRY).  Jitter keeps a fleet of runners restarting off a sick
+    shared host from hot-looping it in lockstep."""
+    if base_s <= 0 or attempt < 1:
+        return 0.0
+    d = min(cap_s, base_s * (2.0 ** (attempt - 1)))
+    return d * (0.5 + 0.5 * rng())

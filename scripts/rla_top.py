@@ -153,7 +153,52 @@ def render(status: dict) -> str:
                 for k, v in sorted((t.get("families") or {}).items()))
             lines.append(f"slo[{label}]: burn "
                          f"{_num(t.get('burn_rate'), '{:.2f}')} ({fams})")
+    rc = status.get("replica_controller")
+    if rc:
+        lines.extend(replica_table(rc))
     return "\n".join(lines)
+
+
+REPLICA_COLS = ("replica", "state", "in-flight", "dispatched", "retries",
+                "hedges", "revived", "p99ms", "burn", "detail")
+
+
+def replica_table(rc: dict):
+    """The serve tier's per-replica controller table (state, in-flight
+    depth, retries, hedges, revivals) fed from the controller snapshot
+    on /statusz (serve/controller.py)."""
+    lines = [
+        f"serve tier: queue {rc.get('queue_depth', 0)}/"
+        f"{rc.get('queue_cap', 0)} "
+        f"(shed at {rc.get('brownout_watermark', '-')}), "
+        f"burn {_num(rc.get('max_burn'), '{:.2f}')}, "
+        f"replicas {len(rc.get('replicas') or {})}"
+        + (f"/{rc['max_replicas']}" if rc.get("max_replicas") else "")]
+    rows = []
+    for label in sorted((rc.get("replicas") or {}),
+                        key=lambda x: (len(x), x)):
+        r = rc["replicas"][label]
+        rows.append((
+            str(label),
+            str(r.get("state", "?")),
+            f"{r.get('inflight_requests', 0)}r/"
+            f"{r.get('inflight_chunks', 0)}c",
+            str(r.get("dispatched_chunks", "-")),
+            str(r.get("retries", "-")),
+            str(r.get("hedges", "-")),
+            str(r.get("revivals", "-")),
+            _num(r.get("p99_step_ms")),
+            _num(r.get("slo_burn"), "{:.2f}"),
+            (r.get("detail") or "")[:32],
+        ))
+    if rows:
+        widths = [max(len(str(c)), *(len(r[i]) for r in rows))
+                  for i, c in enumerate(REPLICA_COLS)]
+        fmt = "  ".join("{:<%d}" % w for w in widths)
+        lines.append(fmt.format(*REPLICA_COLS))
+        for r in rows:
+            lines.append(fmt.format(*r))
+    return lines
 
 
 def main() -> None:

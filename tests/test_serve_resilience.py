@@ -1,0 +1,745 @@
+"""Self-healing serve tier (serve/controller.py): health/load-aware
+routing, retry budgets on the shared backoff, hedging, circuit-breaker
+auto-revival, SLO-burn autoscaling, brownout shedding, and replica-level
+chaos.  All CPU; the controller units run on a fake group (no
+subprocesses), the acceptance loops on real replica pools."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.serve import (AdmissionController,
+                                                  BrownoutShed,
+                                                  ControllerConfig,
+                                                  QueueFull,
+                                                  ReplicaController,
+                                                  ServeMetrics)
+from ray_lightning_accelerators_tpu.serve.controller import (
+    STATE_DRAINING, STATE_OK, STATE_OPEN, STATE_SLOW)
+
+pytestmark = pytest.mark.serve_resilience
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------- #
+# Satellite: the shared backoff module                                   #
+# --------------------------------------------------------------------- #
+def test_backoff_shared_with_elastic_and_sequence_pinned():
+    """utils/backoff.py IS ElasticRunner's backoff (one implementation,
+    re-exported) and the sequence pins the exact historical elastic
+    math: min(cap, base * 2**(a-1)) scaled into [0.5, 1.0)."""
+    from ray_lightning_accelerators_tpu.runtime.elastic import (
+        backoff_delay_s as elastic_backoff)
+    from ray_lightning_accelerators_tpu.utils.backoff import (
+        backoff_delay_s)
+    assert elastic_backoff is backoff_delay_s
+    # the pinned sequence (mirrors the original elastic unit test)
+    assert backoff_delay_s(1, 2.0, rng=lambda: 0.0) == 1.0
+    assert backoff_delay_s(1, 2.0, rng=lambda: 1.0) == 2.0
+    assert backoff_delay_s(3, 2.0, rng=lambda: 1.0) == 8.0
+    assert backoff_delay_s(10, 2.0, cap_s=6.0, rng=lambda: 1.0) == 6.0
+    assert backoff_delay_s(5, 0.0) == 0.0  # base 0 = disabled
+    assert backoff_delay_s(0, 2.0) == 0.0  # attempts are 1-based
+    # identical deterministic sequences for any shared rng
+    seq = [backoff_delay_s(a, 0.5, cap_s=4.0, rng=lambda: 0.25)
+           for a in range(1, 8)]
+    assert seq == [elastic_backoff(a, 0.5, cap_s=4.0, rng=lambda: 0.25)
+                   for a in range(1, 8)]
+    assert seq[:4] == [0.3125, 0.625, 1.25, 2.5]  # then capped
+    assert seq[4:] == [2.5, 2.5, 2.5]
+
+
+# --------------------------------------------------------------------- #
+# Satellite: requeue ordering under multi-replica failure               #
+# --------------------------------------------------------------------- #
+def test_requeue_lane_orders_before_new_admissions_multi_failure():
+    """Chunks requeued head-of-line from TWO failed replicas dispatch
+    before newly admitted requests, in requeue order, and repeated
+    failures keep them at the head (no starvation) — today only the
+    single-failure case was pinned in test_serve."""
+    ctl = AdmissionController(queue_depth=16)
+    subs = [ctl.submit(np.asarray([i + 1], np.int32), 2)
+            for i in range(5)]
+    # replica A took a, b; replica B took c, d; e still queued
+    a, b, c, d = (ctl.pop() for _ in range(4))
+    assert a[1] is subs[0] and d[1] is subs[3]
+    # both replicas fail: requeue A's chunk, then B's
+    assert ctl.requeue(*a) and ctl.requeue(*b)
+    assert ctl.requeue(*c) and ctl.requeue(*d)
+    order = [ctl.pop()[0].request_id for _ in range(5)]
+    assert order == [a[0].request_id, b[0].request_id,
+                     c[0].request_id, d[0].request_id,
+                     subs[4].request.request_id]
+    # repeated failure: the re-requeued request STILL beats the fresh
+    # admission, and its requeue count grows (the budget's input)
+    f = ctl.submit(np.asarray([9], np.int32), 2)
+    assert ctl.requeue(a[0], a[1])
+    assert ctl.pop()[0].request_id == a[0].request_id
+    assert a[0].requeues == 2
+    assert ctl.pop()[1] is f
+
+
+def test_requeue_backoff_holds_lane_without_losing_position():
+    """A retry backoff (not_before) HOLDS the requeue lane: pop returns
+    None until it expires, and the newly admitted request can never
+    overtake the retried one."""
+    ctl = AdmissionController(queue_depth=8)
+    r1 = ctl.submit(np.asarray([1], np.int32), 2)
+    item = ctl.pop()
+    r2 = ctl.submit(np.asarray([2], np.int32), 2)
+    assert ctl.requeue(item[0], item[1], delay_s=0.15)
+    assert ctl.pop() is None          # lane held, r2 must not overtake
+    assert ctl.depth == 2
+    deadline = time.monotonic() + 2.0
+    got = None
+    while got is None and time.monotonic() < deadline:
+        got = ctl.pop()
+        if got is None:
+            time.sleep(0.01)
+    assert got is not None and got[1] is r1
+    assert ctl.pop()[1] is r2
+
+
+# --------------------------------------------------------------------- #
+# Satellite: replica-level chaos syntax                                 #
+# --------------------------------------------------------------------- #
+def test_chaos_replica_faults_parse_and_filter():
+    from ray_lightning_accelerators_tpu.testing.chaos import (
+        ChaosInjector, parse_chaos)
+    f = parse_chaos("crash@replica1:chunk2:once,hang@replica0,"
+                    "slow@replica2:1.5,hang@rank1:step2")
+    assert [(x.kind, x.rank, x.step, x.layer, x.once) for x in f] == [
+        ("crash", 1, 2, "replica", True),
+        ("hang", 0, None, "replica", False),
+        ("slow", 2, None, "replica", False),
+        ("hang", 1, 2, "worker", False)]
+    assert f[2].delay_s == 1.5
+    # chunk-less crash fires on the first chunk; slow on every chunk
+    assert f[1].matches(0, 1) and not f[1].matches(0, 2)
+    assert f[2].matches(2, 1) and f[2].matches(2, 7)
+    # replica claim tokens are layer-prefixed (never collide with a
+    # worker dispatch claim of the same kind/step)
+    assert f[0].token(1).startswith("replica-")
+    assert f[3].token(1) == "hang-rank1-step2-r1"
+    # each seam only honors its own layer
+    wi = ChaosInjector(f, 1, ns_dir="/tmp")
+    ri = ChaosInjector(f, 1, ns_dir="/tmp", layer="replica")
+    assert [x.layer for x in wi.faults] == ["worker"]
+    assert all(x.layer == "replica" for x in ri.faults)
+    for bad in ("preempt@replica0", "lost@replica1",
+                "crash@replica0:step2", "crash@rank0:chunk2",
+                "crash@replica0:chunk0"):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+
+# --------------------------------------------------------------------- #
+# Controller units (fake group — no subprocesses)                       #
+# --------------------------------------------------------------------- #
+class _FakeWorker:
+    def __init__(self, rank, alive=True):
+        self.rank = rank
+        self.is_alive = alive
+
+
+class _FakePool:
+    def __init__(self, n):
+        self.workers = [_FakeWorker(r) for r in range(n)]
+
+
+class _FakeBatcher:
+    def __init__(self):
+        self.depth = 0
+
+
+class _FakeGroup:
+    queue_depth = 16
+
+    def __init__(self, n=3):
+        self.pool = _FakePool(n)
+        self.batcher = _FakeBatcher()
+        self.metrics = ServeMetrics()
+        self.watchdog = None
+        self.dispatched = []
+        self.revive_results = []  # None = ok, exc = raise
+        self.revived = []
+        self.retired = []
+
+    def _worker(self, rank):
+        for w in self.pool.workers:
+            if w.rank == rank:
+                return w
+        return None
+
+    def _dispatch(self, rank, chunk, hedge_of=None):
+        self.dispatched.append((rank, list(chunk), hedge_of))
+
+    def _revive_replica(self, rank):
+        outcome = (self.revive_results.pop(0)
+                   if self.revive_results else None)
+        if outcome is not None:
+            raise outcome
+        self.revived.append(rank)
+        return {}
+
+    def _add_replica(self):
+        rank = max(w.rank for w in self.pool.workers) + 1
+        self.pool.workers.append(_FakeWorker(rank))
+        return rank
+
+    def _retire_replica(self, rank):
+        self.retired.append(rank)
+        self.pool.workers = [w for w in self.pool.workers
+                             if w.rank != rank]
+
+
+def _fake_item():
+    from ray_lightning_accelerators_tpu.serve.batcher import (
+        ServeRequest, ServeResponse)
+    req = ServeRequest(0, np.asarray([1], np.int32), 2, time.monotonic())
+    return req, ServeResponse(req)
+
+
+def test_routing_skips_unhealthy_and_weights_inflight():
+    g = _FakeGroup(3)
+    ctrl = ReplicaController(g, ControllerConfig(max_inflight_chunks=2))
+    # load-aware: all healthy, all idle -> least-loaded (any); add load
+    ctrl.on_dispatch(0, [_fake_item(), _fake_item()])
+    assert ctrl.route() in (1, 2)
+    ctrl.on_dispatch(1, [_fake_item()])
+    assert ctrl.route() == 2
+    # slow replicas are last-resort only
+    ctrl._replicas[2].state = STATE_SLOW
+    assert ctrl.route() == 1            # healthy-but-loaded beats slow
+    ctrl._replicas[1].state = STATE_OPEN
+    ctrl.on_dispatch(0, [_fake_item()])  # 0 at max_inflight_chunks
+    assert ctrl.route() == 2            # only the slow one can take it
+    ctrl._replicas[2].state = STATE_DRAINING
+    assert ctrl.route() is None
+    # a dead worker opens its circuit at routing time
+    ctrl._replicas[2].state = STATE_OK
+    g._worker(2).is_alive = False
+    assert ctrl.route() is None
+    assert ctrl._replicas[2].state == STATE_OPEN
+    assert 2 in ctrl.down_ranks()
+
+
+def test_circuit_breaker_opens_backs_off_and_half_open_probes():
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(
+        revive_backoff_s=0.2, revive_backoff_cap_s=2.0,
+        breaker_window_s=5.0, breaker_failures=2))
+    # one death holding TWO chunks = ONE breaker failure (the second
+    # in-flight callback must not double-count the same death)
+    cid = ctrl.on_dispatch(1, [_fake_item()])
+    cid2 = ctrl.on_dispatch(1, [_fake_item()])
+    ctrl.note_infra_failure(1, cid, RuntimeError("worker died"))
+    ctrl.note_infra_failure(1, cid2, RuntimeError("worker died"))
+    r = ctrl._replicas[1]
+    assert len(r.failures) == 1
+    assert r.inflight_chunks == 0
+    assert r.state == STATE_OPEN and r.open_until > time.monotonic()
+    first_open = r.open_until
+    # before the backoff expires: no revival attempt
+    assert ctrl.maybe_revive(now=time.monotonic()) == 0
+    assert not g.revived
+    # expired: half-open probe -> success closes the circuit
+    r.open_until = time.monotonic() - 0.01
+    assert ctrl.maybe_revive() == 1
+    assert g.revived == [1]
+    assert r.state == STATE_OK and r.revivals == 1
+    assert g.metrics.snapshot()["revived"] == 1
+    # open again; the breaker threshold (2) is now reached, so the
+    # reopen delay grows: attempt 2 with half-jitter floors at
+    # 0.5*base*2 = the attempt-1 max
+    cid = ctrl.on_dispatch(1, [_fake_item()])
+    ctrl.note_infra_failure(1, cid, RuntimeError("worker died"))
+    assert r.state == STATE_OPEN
+    assert len(r.failures) == 2
+    assert r.open_until - time.monotonic() >= 0.18
+    del first_open
+    g.revive_results = [RuntimeError("still dead")]
+    r.open_until = time.monotonic() - 0.01
+    assert ctrl.maybe_revive() == 0
+    assert r.state == STATE_OPEN and r.revive_attempts == 1
+    assert r.open_until > time.monotonic()
+
+
+def test_hedge_fires_once_per_chunk_to_healthy_replica():
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(hedge_age_s=0.05))
+    items = [_fake_item(), _fake_item()]
+    cid = ctrl.on_dispatch(0, items)
+    ctrl._replicas[0].state = STATE_SLOW
+    chunk = ctrl._replicas[0].chunks[cid]
+    chunk.t_dispatch -= 1.0  # old enough to hedge
+    assert ctrl.maybe_hedge() == 1
+    assert len(g.dispatched) == 1
+    rank, hedged_items, hedge_of = g.dispatched[0]
+    assert rank == 1 and hedge_of == (0, cid)
+    assert [id(r) for r, _ in hedged_items] == [id(r) for r, _ in items]
+    assert g.metrics.snapshot()["hedged"] == 1
+    assert ctrl._replicas[0].hedges == 1
+    # a chunk hedges at most once
+    assert ctrl.maybe_hedge() == 0
+    ctrl.note_success(0, cid)
+    # already-done responses are excluded: nothing unresolved => no fire
+    cid2 = ctrl.on_dispatch(0, items)
+    ctrl._replicas[0].chunks[cid2].t_dispatch -= 1.0
+    items[0][1]._complete(np.asarray([1, 2], np.int32))
+    items[1][1]._complete(np.asarray([1, 2], np.int32))
+    assert ctrl.maybe_hedge() == 0
+    assert not ctrl._replicas[0].chunks[cid2].hedged  # retryable later
+    ctrl.note_success(0, cid2)
+    # and never onto a non-healthy target
+    cid3 = ctrl.on_dispatch(0, [_fake_item()])
+    ctrl._replicas[0].chunks[cid3].t_dispatch -= 1.0
+    ctrl._replicas[1].state = STATE_OPEN
+    assert ctrl.maybe_hedge() == 0
+    assert not ctrl._replicas[0].chunks[cid3].hedged
+
+
+def test_autoscale_up_on_burn_and_graceful_drain_on_idle():
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(
+        max_replicas=3, min_replicas=2, scale_up_burn=1.0,
+        scale_sustain_s=0.2, idle_sustain_s=0.2, burn_stale_s=30.0))
+    t0 = time.monotonic()
+    # sustained burn (fresh reading) -> one scale-up, bounded by max
+    ctrl._replicas[0].slo_burn = 2.0
+    ctrl._replicas[0].burn_updated = t0
+    ctrl.autoscale(now=t0)            # arms the sustain window
+    assert len(g.pool.workers) == 2
+    ctrl.autoscale(now=t0 + 0.3)      # sustained -> grow
+    assert len(g.pool.workers) == 3
+    assert ctrl._replicas[2].scaled
+    assert g.metrics.snapshot()["scale_ups"] == 1
+    ctrl._replicas[0].burn_updated = t0 + 0.3
+    ctrl.autoscale(now=t0 + 0.4)
+    ctrl.autoscale(now=t0 + 0.7)      # at max_replicas: no growth
+    assert len(g.pool.workers) == 3
+    # idle (stale burn, empty queue, nothing in flight) -> drain the
+    # SCALED replica first, then retire it once empty
+    ctrl._replicas[0].slo_burn = 0.0
+    t1 = t0 + 1.0
+    ctrl.autoscale(now=t1)            # arms idle
+    # sustained idle -> the SCALED replica drains; empty, it retires in
+    # the same sweep (a replica with in-flight work would sit DRAINING
+    # until its chunks finish on the normal retire path)
+    ctrl.autoscale(now=t1 + 0.3)
+    assert g.retired == [2]
+    assert 2 not in ctrl._replicas
+    assert g.metrics.snapshot()["scale_downs"] == 1
+    # at the min_replicas floor: never drains below
+    ctrl.autoscale(now=t1 + 1.0)
+    ctrl.autoscale(now=t1 + 2.0)
+    assert sorted(ctrl._replicas) == [0, 1]
+    assert all(r.state != STATE_DRAINING
+               for r in ctrl._replicas.values())
+
+
+def test_stale_burn_reads_as_zero():
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(burn_stale_s=0.5))
+    now = time.monotonic()
+    ctrl._replicas[0].slo_burn = 5.0
+    ctrl._replicas[0].burn_updated = now
+    assert ctrl._overload_signals(now)[0] == 5.0
+    assert ctrl._overload_signals(now + 1.0)[0] == 0.0
+
+
+def test_brownout_decision_and_typed_shape():
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(
+        brownout_frac=0.5, max_replicas=None))
+    g.batcher.depth = 7
+    assert ctrl.should_shed() is None
+    g.batcher.depth = 8                  # watermark = 0.5 * 16
+    shed = ctrl.should_shed()
+    assert shed == (8, 8, 16)
+    # with scale-up headroom the tier grows instead of shedding
+    ctrl2 = ReplicaController(g, ControllerConfig(
+        brownout_frac=0.5, max_replicas=4))
+    assert ctrl2.should_shed() is None
+    exc = BrownoutShed(*shed)
+    assert isinstance(exc, QueueFull)    # same retry-later contract
+    assert "brownout" in str(exc) and "watermark" in str(exc)
+
+
+# --------------------------------------------------------------------- #
+# Observability: /statusz table, Prometheus family, rla_top             #
+# --------------------------------------------------------------------- #
+def test_controller_snapshot_statusz_and_prometheus_family():
+    from ray_lightning_accelerators_tpu.telemetry.live import LiveSources
+    from tests.utils import assert_prometheus_exposition
+
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig())
+    cid = ctrl.on_dispatch(0, [_fake_item()])
+    ctrl.note_success(0, cid, {"decode_step_s": {"p99_s": 0.012},
+                               "slo_burn_rate": 1.5,
+                               "compile_count": 4})
+    snap = ctrl.snapshot()
+    assert set(snap["replicas"]) == {"0", "1"}
+    row = snap["replicas"]["0"]
+    assert row["state"] == "ok" and row["dispatched_chunks"] == 1
+    assert row["p99_step_ms"] == 12.0 and row["slo_burn"] == 1.5
+    assert snap["brownout_watermark"] == 14  # 0.9 * 16
+    json.dumps(snap)  # must stay JSON-able for /statusz
+
+    src = LiveSources()
+    src.bind_replica_controller(ctrl)
+    statusz = src.statusz()
+    assert statusz["replica_controller"]["replicas"]["0"][
+        "completed_chunks"] == 1
+    reg = src.build_registry()
+    assert reg.to_json()["replica_controller"]["max_burn"] == 1.5
+    text = reg.prometheus_text()
+    assert_prometheus_exposition(text)
+    assert 'rla_tpu_serve_replica_state{replica="0",state="ok"} 1' \
+        in text
+    assert 'rla_tpu_serve_replica_dispatched_chunks_total' \
+           '{replica="0"} 1' in text
+    assert "rla_tpu_serve_replica_count 2" in text
+    assert "rla_tpu_serve_tier_queue_depth 0" in text
+    # unbind: the table leaves the scrape
+    src.bind_replica_controller(None)
+    assert "replica_controller" not in src.statusz()
+    # sibling-group safety: a shut-down group's unbind must not evict
+    # a controller some OTHER group bound after it (last bound wins)
+    ctrl2 = ReplicaController(_FakeGroup(1), ControllerConfig())
+    src.bind_replica_controller(ctrl)
+    src.bind_replica_controller(ctrl2)
+    src.unbind_replica_controller(ctrl)   # no-op: not the bound one
+    assert set(src.statusz()["replica_controller"]["replicas"]) == {"0"}
+    src.unbind_replica_controller(ctrl2)
+    assert "replica_controller" not in src.statusz()
+
+
+def test_rla_top_renders_replica_table():
+    spec = importlib.util.spec_from_file_location(
+        "rla_top", os.path.join(_ROOT, "scripts", "rla_top.py"))
+    rla_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rla_top)
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig())
+    cid = ctrl.on_dispatch(1, [_fake_item()])
+    ctrl.note_infra_failure(1, cid, RuntimeError("worker died"))
+    status = {"rank": "driver", "trace_id": "t", "health": {},
+              "replica_controller": ctrl.snapshot()}
+    out = rla_top.render(status)
+    assert "serve tier: queue 0/16" in out
+    assert "replica" in out and "state" in out
+    lines = [ln for ln in out.splitlines()]
+    row1 = next(ln for ln in lines if ln.startswith("1 "))
+    assert "open" in row1
+    row0 = next(ln for ln in lines if ln.startswith("0 "))
+    assert "ok" in row0
+
+
+# --------------------------------------------------------------------- #
+# Real-pool acceptance loops                                            #
+# --------------------------------------------------------------------- #
+_REPLICA_CFG = dict(vocab_size=61, d_model=32, n_heads=2, d_ff=64,
+                    n_layers=2, max_seq_len=48)
+
+
+def _replica_factory(np_params, slo_ttft_s=None):
+    """Engine factory executed inside each worker (cloudpickled closure;
+    params travel as numpy)."""
+    def make():
+        from ray_lightning_accelerators_tpu.models.transformer import (
+            GPT, TransformerConfig)
+        from ray_lightning_accelerators_tpu.serve import (ServeEngine,
+                                                          SloPolicy)
+        model = GPT(TransformerConfig(**_REPLICA_CFG))
+        slo = (SloPolicy(ttft_target_s=slo_ttft_s)
+               if slo_ttft_s is not None else None)
+        return ServeEngine(model, np_params, max_slots=4,
+                           queue_depth=64, slo=slo)
+    return make
+
+
+def _model_and_np_params(seed=0):
+    import jax
+
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    model = GPT(TransformerConfig(**_REPLICA_CFG))
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, jax.tree.map(np.asarray, params)
+
+
+def test_auto_revive_republishes_portfile_and_heartbeat(tmp_path):
+    """Satellite 2 + the breaker's end-to-end revive: kill a replica's
+    process, submit — the circuit opens at routing, the breaker
+    restarts it, and the REVIVED generation re-publishes its telemetry
+    portfile (new pid) and heartbeat channel, so it reappears in
+    ClusterView/rla_top and serves the queued request."""
+    from ray_lightning_accelerators_tpu.serve import ServeReplicas
+    from ray_lightning_accelerators_tpu.telemetry import live as live_lib
+
+    tdir = str(tmp_path / "telemetry")
+    env = {"RLA_TPU_WORKER_HEARTBEAT_S": "0.1",
+           "RLA_TPU_TELEMETRY_DIR": tdir,
+           "RLA_TPU_METRICS_PORT": "0"}
+    model, params, np_params = _model_and_np_params()
+    group = ServeReplicas(
+        _replica_factory(np_params), num_replicas=1, chunk_size=2,
+        env_per_worker=[env],
+        controller=ControllerConfig(revive_backoff_s=0.1,
+                                    revive_backoff_cap_s=0.5,
+                                    poll_s=0.05))
+    try:
+        out = group.submit(np.asarray([1, 2, 3], np.int32), 3)
+        np.testing.assert_array_equal(
+            out.result(timeout=120),
+            np.asarray(model.generate(
+                params, np.asarray([[1, 2, 3]], np.int32),
+                max_new_tokens=3))[0])
+        portfile = os.path.join(tdir, "rank0.port.json")
+        deadline = time.monotonic() + 30
+        while not os.path.exists(portfile) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        with open(portfile) as f:
+            pid_before = json.load(f)["pid"]
+        # kill the replica process outright; the next dispatch finds it
+        w = group._worker(0)
+        w.kill()
+        assert not w.is_alive
+        resp = group.submit(np.asarray([4, 5], np.int32), 3)
+        tokens = resp.result(timeout=120)  # served after auto-revive
+        np.testing.assert_array_equal(
+            tokens, np.asarray(model.generate(
+                params, np.asarray([[4, 5]], np.int32),
+                max_new_tokens=3))[0])
+        snap = group.stats()
+        assert snap["revived"] >= 1
+        assert snap["controller"]["replicas"]["0"]["revivals"] >= 1
+        # the revived GENERATION re-published its portfile...
+        with open(portfile) as f:
+            rec = json.load(f)
+        assert rec["pid"] != pid_before and rec["port"]
+        # ...its endpoint scrapes (the ClusterView seam)...
+        live_snap = live_lib.scrape_rank(0, env=env)
+        assert live_snap and live_snap["rank"] == "0"
+        # ...and its heartbeat channel is the new generation's (fresh
+        # and beating, so the watchdog supervises the revived process)
+        beat = w.heartbeat.snapshot()
+        assert beat["started"] and beat["beat_age_s"] < 5.0
+    finally:
+        group.shutdown()
+
+
+@pytest.mark.chaos
+def test_brownout_sheds_typed_at_watermark():
+    """A saturated tier (replica slowed by chaos, no scale-up headroom)
+    sheds typed BrownoutShed at the watermark instead of queueing to
+    the hard cap."""
+    from ray_lightning_accelerators_tpu.serve import ServeReplicas
+
+    _, _, np_params = _model_and_np_params()
+    env = {"RLA_TPU_WORKER_HEARTBEAT_S": "0.2",
+           "RLA_TPU_CHAOS": "slow@replica0:2.0"}
+    group = ServeReplicas(
+        _replica_factory(np_params), num_replicas=1, chunk_size=1,
+        queue_depth=4,
+        env_per_worker=[env],
+        controller=ControllerConfig(brownout_frac=0.5, hedge=False,
+                                    poll_s=0.05))
+    try:
+        shed = None
+        for i in range(12):
+            try:
+                group.submit(np.asarray([1 + i % 7], np.int32), 2)
+            except BrownoutShed as e:
+                shed = e
+                break
+            time.sleep(0.02)
+        assert shed is not None, "tier never shed at the watermark"
+        assert isinstance(shed, QueueFull)  # retry-later contract
+        assert shed.watermark == 2 and shed.depth >= 2
+        snap = group.metrics.snapshot()
+        assert snap["brownout_shed"] >= 1
+        assert snap["rejected"] >= 1
+    finally:
+        group.shutdown()
+
+
+def _compile_counts(group):
+    rows = group.stats()["controller"]["replicas"]
+    return {r: row["compile_count"] for r, row in rows.items()
+            if row["compile_count"] is not None}
+
+
+@pytest.mark.chaos
+def test_acceptance_chaos_kill_hang_scale_and_drain(tmp_path):
+    """THE acceptance loop: sustained mixed load with crash@replica0 and
+    hang@replica1 (replica-level chaos, once each) — every admitted
+    request resolves exactly once (accounting proves no loss/dup and
+    every response is token-identical to generate()), both replicas
+    auto-revive through the circuit breaker, the controller scales up
+    on the forced SLO-burn overload (tiny TTFT target => burn
+    saturates) and drains back down cleanly once idle — with zero
+    steady-state recompiles per replica (compile counts ride every
+    chunk's stats and are pinned flat across the final round)."""
+    from ray_lightning_accelerators_tpu.serve import ServeReplicas
+
+    model, params, np_params = _model_and_np_params()
+    ns = str(tmp_path / "chaos-ns")
+    hb = {"RLA_TPU_WORKER_HEARTBEAT_S": "0.1",
+          "RLA_TPU_SLO_WINDOW_S": "3"}
+    envs = [
+        dict(hb, RLA_TPU_CHAOS="crash@replica0:chunk2:once",
+             RLA_TPU_CHAOS_NS=ns),
+        dict(hb, RLA_TPU_CHAOS="hang@replica1:chunk2:once",
+             RLA_TPU_CHAOS_NS=ns),
+    ]
+    cfg = ControllerConfig(
+        max_retries=4,
+        retry_backoff_s=0.01, retry_backoff_cap_s=0.1,
+        revive_backoff_s=0.2, revive_backoff_cap_s=1.0,
+        max_replicas=3, min_replicas=2,
+        scale_up_burn=1.0, occupancy_high=0.95,
+        scale_sustain_s=0.4, idle_sustain_s=3.0, burn_stale_s=2.0,
+        # hedge only genuinely stuck chunks: CPU chunks run ~1s, so the
+        # default watchdog-derived age would hedge healthy work and the
+        # chunk-count faults would land on hedge copies instead of the
+        # requeue path this loop pins (hedging itself is unit-tested)
+        hedge_age_s=5.0,
+        poll_s=0.05)
+    rng = np.random.default_rng(11)
+
+    def mixed(n):
+        # one prompt bucket (<=14 < block 16) so a warm engine never
+        # compiles a new serve program mid-run (the zero-recompile
+        # pin), and few driver-side shapes so the generate() reference
+        # path compiles a bounded set too
+        return [(rng.integers(0, 61, size=(
+            int(rng.choice([4, 8, 12])),)).astype(np.int32),
+            int(rng.choice([3, 4]))) for _ in range(n)]
+
+    def drive_checked(n):
+        """One wave: refs FIRST (driver-side generate), then a tight
+        submission burst, then exactness — keeps the tier continuously
+        busy during a wave so idle gaps between waves stay well under
+        idle_sustain_s (a slow sequential ref+wait loop would starve
+        the tier mid-test and read as a real idle watermark)."""
+        pairs = mixed(n)
+        refs = [np.asarray(model.generate(
+            params, np.asarray(p[None]), max_new_tokens=k))[0]
+            for p, k in pairs]
+        handles = [group.submit(p, k) for p, k in pairs]
+        for ref, h in zip(refs, handles):
+            np.testing.assert_array_equal(h.result(timeout=300), ref)
+
+    stop_feed = threading.Event()
+    group = ServeReplicas(
+        _replica_factory(np_params, slo_ttft_s=1e-4), num_replicas=2,
+        chunk_size=2, heartbeat_s=0.1, wedge_timeout_s=1.2,
+        queue_depth=64, env_per_worker=envs, controller=cfg,
+        scale_env=dict(hb))
+    try:
+        # -- phase 1: sustained load provoking the kill + the hang ----- #
+        # keep waves coming until both faulted replicas have revived
+        # through the breaker (bounded); every wave checked exact
+        deadline = time.monotonic() + 150
+        revived_ok = False
+        while time.monotonic() < deadline:
+            drive_checked(4)
+            if group.metrics.snapshot()["revived"] >= 2:
+                revived_ok = True
+                break
+        assert revived_ok, group.stats()["controller"]
+        snap = group.stats()
+        assert snap["wedge_events"] >= 1          # the hang was a reap
+        rows = snap["controller"]["replicas"]
+        # both faults really fired (one infra failure each) and the
+        # lost chunks' requests came back through the head-of-line
+        # requeue lane
+        assert rows["0"]["infra_failures"] >= 1
+        assert rows["1"]["infra_failures"] >= 1
+        assert snap["requeued"] >= 1, snap
+        assert rows["0"]["revivals"] >= 1
+        assert rows["1"]["revivals"] >= 1
+
+        # continuous background feed through phases 2-3: wave cadence
+        # alone can leave >idle_sustain_s gaps under host load, and an
+        # idle tier legitimately drains — the feeder keeps the tier
+        # busy so scale state only moves when the test means it to
+        feed_p = rng.integers(0, 61, size=(8,)).astype(np.int32)
+        feed_ref = np.asarray(model.generate(
+            params, np.asarray(feed_p[None]), max_new_tokens=3))[0]
+        feed_handles = []
+
+        def feeder():
+            while not stop_feed.is_set():
+                try:
+                    feed_handles.append(group.submit(feed_p, 3))
+                except QueueFull:  # backpressure is fine, not a failure
+                    pass
+                except Exception:
+                    return  # group torn down after a primary failure
+                time.sleep(0.15)
+
+        feed_thread = threading.Thread(target=feeder, daemon=True)
+        feed_thread.start()
+
+        # -- phase 2: forced SLO-burn overload scales the tier up ------ #
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline \
+                and group.metrics.snapshot()["scale_ups"] < 1:
+            drive_checked(6)
+        assert group.metrics.snapshot()["scale_ups"] >= 1, \
+            group.stats()["controller"]
+        assert len(group.pool) == 3
+        # the scale-up signal was the real SLO burn (every request
+        # violates the 0.1ms TTFT target), not queue occupancy
+        assert group.stats()["controller"]["max_burn"] >= 1.0
+
+        # -- phase 3: zero steady-state recompiles, compile-guard style #
+        # (every chunk result carries the replica's backend-compile
+        # count; warm until flat, then pin the final round at zero)
+        prev, stable = None, False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            drive_checked(8)
+            counts = _compile_counts(group)
+            if prev is not None and counts == prev and len(counts) == 3:
+                stable = True
+                break
+            prev = counts
+        assert stable, f"compile counts never settled: {prev}"
+        drive_checked(8)
+        assert _compile_counts(group) == prev  # ZERO new compiles
+
+        # -- phase 4: idle -> graceful drain back to min_replicas ------ #
+        stop_feed.set()
+        feed_thread.join(timeout=10)
+        for h in feed_handles:  # the background stream was exact too
+            np.testing.assert_array_equal(h.result(timeout=300),
+                                          feed_ref)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and len(group.pool) != 2:
+            time.sleep(0.2)
+        snap = group.stats()
+        assert len(group.pool) == 2, snap["controller"]
+        assert snap["scale_downs"] >= 1
+        # the autoscaled replica(s) drained first; originals survive
+        assert sorted(w.rank for w in group.pool.workers) == [0, 1]
+
+        # -- exactly-once accounting over the WHOLE run ---------------- #
+        # (every response was also asserted token-identical above)
+        assert snap["failed"] == 0
+        assert snap["cancelled"] == 0
+        assert snap["completed"] == snap["submitted"]
+    finally:
+        stop_feed.set()
+        group.shutdown()
