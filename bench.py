@@ -754,6 +754,17 @@ def bench_perf_observatory() -> dict:
     return _run_cpu_probe("perf_observatory_probe.py", "perf_observatory")
 
 
+def bench_resize() -> dict:
+    """Live-resize downtime bench (parallel/plan.py +
+    parallel/redistribute.py + Trainer.resize_in_memory): one dp=8 fit
+    interrupted at step 2 is recovered into a dp=4 world both ways —
+    checkpoint round-trip vs in-memory redistribution — and the value is
+    the downtime ratio (recovery entry → first completed dp=4 step;
+    must be strictly > 1), on a forced-host-platform 8-device CPU mesh
+    (see ``_run_cpu_probe``)."""
+    return _run_cpu_probe("resize_probe.py", "resize")
+
+
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
            "input_pipeline": bench_input_pipeline,
@@ -762,7 +773,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "mfu_overlap": bench_mfu_overlap,
            "perf_observatory": bench_perf_observatory,
            "live_plane": bench_live_plane,
-           "serve_resilience": bench_serve_resilience}
+           "serve_resilience": bench_serve_resilience,
+           "resize": bench_resize}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -788,7 +800,7 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
                          "perf_observatory", "live_plane",
-                         "serve_resilience")
+                         "serve_resilience", "resize")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -892,7 +904,7 @@ def main() -> None:
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
                 "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory,"
-                "live_plane,serve_resilience",
+                "live_plane,serve_resilience,resize",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--gate", action="store_true",
                         help="run no benches: gate a bench window "

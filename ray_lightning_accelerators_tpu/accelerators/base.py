@@ -18,9 +18,10 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ..parallel import mesh as mesh_lib
+from ..parallel import plan as plan_lib
 from ..parallel import sharding as sharding_lib
 from ..utils.logging import log
 
@@ -123,7 +124,7 @@ class Accelerator:
         ``report_fallbacks=False`` (probe calls) — emits a telemetry
         event (kind ``fsdp_fallback``) so the silent loss of FSDP
         memory savings shows up in the unified MetricsRegistry export."""
-        repl = NamedSharding(mesh, P())
+        repl = plan_lib.replicated_sharding(mesh)
         if report_fallbacks:
             # every REPORTING resolution re-records its fallbacks, so a
             # later fit on this accelerator never mirrors a previous
@@ -162,7 +163,7 @@ class Accelerator:
         param's layout via ``optax.tree_map_params``."""
         import optax as _optax
 
-        repl = NamedSharding(mesh, P())
+        repl = plan_lib.replicated_sharding(mesh)
         param_sh = self.param_shardings(mesh, state.params, module=module,
                                         report_fallbacks=report_fallbacks)
 
@@ -202,8 +203,9 @@ class Accelerator:
         # grad_accum is stacked under pure DP ([n, *param] — one more
         # dim than its param, so the shape test below cannot collide)
         # but PARAM-shaped (post-exchange, shard-local) under compressed
-        # FSDP, where it inherits the param layout
-        stacked = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+        # FSDP, where it inherits the param layout; the layouts are
+        # authored in parallel/plan.py (the single spec-producing module)
+        stacked = plan_lib.stacked_replica_sharding(mesh)
 
         def accum_sh(tree):
             if tree is None:

@@ -467,7 +467,8 @@ DEFAULT_WORKER_MODULES: Tuple[str, ...] = (
 DEFAULT_INVENTORY_MODULES: Tuple[str, ...] = (
     "parallel/mesh.py", "parallel/sharding.py", "parallel/collectives.py",
     "parallel/ulysses.py", "parallel/ring_attention.py",
-    "parallel/pipeline.py", "core/trainer.py", "accelerators/base.py",
+    "parallel/pipeline.py", "parallel/plan.py", "core/trainer.py",
+    "accelerators/base.py",
 )
 
 

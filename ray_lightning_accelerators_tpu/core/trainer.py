@@ -284,6 +284,13 @@ class Trainer:
         # param shardings when the compressed exchange runs in the FSDP
         # (reduce-scatter/all-gather) regime; None = replicated-DP regime
         self._fsdp_param_sh = None
+        # the resolved ShardingPlan (parallel/plan.py) for the current
+        # mesh — the layout value the elastic resize path diffs and
+        # redistributes against; set by _resolve_state_shardings
+        self._plan = None
+        # first training batch of the last fit — the compile template a
+        # live resize recompiles against (the loader is long gone then)
+        self._example_batch = None
         # (effective gather mode, scanned top-level keys) resolved per
         # compile — "scan" only when the FSDP regime is live AND the
         # module declares a compatible layer stack
@@ -302,6 +309,9 @@ class Trainer:
         state = dict(self.__dict__)
         state["_world"] = None
         state["_preempt_notice"] = None
+        # the resolved ShardingPlan holds live Device objects (meshes /
+        # NamedShardings); workers re-resolve it at their own _compile
+        state["_plan"] = None
         # the live server/cluster view hold sockets + threads; workers
         # start their own at boot (actors._worker_main) and bind their
         # copy of the trainer to it at fit
@@ -711,42 +721,23 @@ class Trainer:
         layout against the NEW (possibly smaller) mesh, once per
         candidate template, and restores straight into it
         (``report_fallbacks=False`` there so one fallback leaf does not
-        emit one event per template)."""
-        from ..parallel import collectives as collectives_lib
+        emit one event per template).
 
-        mesh = self._mesh
-        state_sh = self.accelerator.state_shardings(
-            mesh, state, module=module, tx=self._tx,
+        The resolution itself lives in ``parallel/plan.build_plan`` (the
+        declarative ShardingPlan the elastic resize path builds for
+        meshes the run is not on yet); this wrapper binds the plan to
+        the trainer's mesh and caches it on ``self._plan``."""
+        from ..parallel import plan as plan_lib
+
+        plan = plan_lib.build_plan(
+            self._mesh, self.accelerator, module, state, self._tx,
+            grad_compression=self.grad_compression,
+            shard_optimizer_state=self.shard_optimizer_state,
             report_fallbacks=report_fallbacks)
-        params_replicated = all(
-            s.is_fully_replicated for s in jax.tree.leaves(state_sh.params))
-        self._fsdp_param_sh = None
-        if self.grad_compression is not None and not params_replicated:
-            # compressed FSDP: fsdp-sharded params ride the quantized
-            # reduce-scatter-into-owner exchange (ZeRO-2/3,
-            # collectives.build_fsdp_exchange); any model-parallel
-            # (tensor/sequence/pipeline) sharding refuses typed — those
-            # gradients are not replicas over the batch axes, so a
-            # quantized replica exchange of them would be silently wrong
-            for s in jax.tree.leaves(state_sh.params):
-                collectives_lib.fsdp_shard_dim(s)  # raises typed on TP
-            self._fsdp_param_sh = state_sh.params
-        self._zero1_update_sh = None
-        if self.shard_optimizer_state:
-            if not params_replicated:
-                log.warning(
-                    "shard_optimizer_state=True with sharded params: the "
-                    "optimizer state already inherits the FSDP/TP layout; "
-                    "ZeRO-1 re-sharding is skipped")
-            else:
-                opt_sh = collectives_lib.zero1_opt_shardings(
-                    mesh, self._tx, state.opt_state, state.params)
-                if opt_sh is not None:
-                    state_sh = state_sh.replace(opt_state=opt_sh)
-                    self._zero1_update_sh = \
-                        collectives_lib.zero1_update_shardings(
-                            mesh, state.params)
-        return state_sh
+        self._plan = plan
+        self._fsdp_param_sh = plan.fsdp_param_shardings
+        self._zero1_update_sh = plan.zero1_update_shardings
+        return plan.state_shardings
 
     def _resolve_gather_mode(self, module, params, param_sh,
                              quiet: bool = False):
@@ -787,6 +778,176 @@ class Trainer:
                             reason)
             return "tree", ()
         return "scan", scanned
+
+    def _fresh_exchange_buffers(self, module: TpuModule, params,
+                                mesh) -> tuple:
+        """(residual, grad_accum) zero trees for ``mesh``'s world under
+        grad_compression — per-replica state whose leading dim IS the
+        world size, so fit init, the cross-world restore path and the
+        in-memory resize all rebuild it identically from here.
+
+        The exchange regime decides the buffer shapes, so the param
+        layout is probed first (quiet: _compile's authoritative
+        resolution emits the fallback telemetry once); fsdp-sharded
+        params get shard-local (1/N) residuals and param-shaped
+        (post-exchange) accumulators — model-parallel shardings refuse
+        typed right here."""
+        from ..parallel import collectives as collectives_lib
+        n_dp = mesh_lib.data_parallel_size(mesh)
+        param_sh = self.accelerator.param_shardings(
+            mesh, params, module=module, report_fallbacks=False)
+        fsdp_mode = any(
+            collectives_lib.fsdp_shard_dim(s) is not None
+            for s in jax.tree.leaves(param_sh))
+        if fsdp_mode:
+            # scan-gathered leaves never ride the quantized exchange
+            # (their reduce-scatter is the in-scan gather's exact
+            # transpose), so they get residual placeholders
+            _, scanned = self._resolve_gather_mode(
+                module, params, param_sh, quiet=True)
+            residual = collectives_lib.fsdp_residual_zeros(
+                params, param_sh, self._exchange_cfg, scanned=scanned)
+            grad_accum = (jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                if self.accumulate_grad_batches > 1 else None)
+        else:
+            residual = collectives_lib.residual_zeros(
+                params, n_dp, self._exchange_cfg)
+            grad_accum = (collectives_lib.accum_zeros(params, n_dp)
+                          if self.accumulate_grad_batches > 1 else None)
+        return residual, grad_accum
+
+    # ------------------------------------------------------------------ #
+    # Live elastic resharding                                             #
+    # ------------------------------------------------------------------ #
+    def resize_in_memory(self, num_workers: int, *,
+                         max_bytes: Optional[int] = None) -> Dict[str, Any]:
+        """Re-plan the live state onto a ``num_workers``-wide mesh and
+        redistribute the shards IN MEMORY — no checkpoint round-trip.
+
+        Validation happens strictly before mutation: the new mesh, the
+        new :class:`~..parallel.plan.ShardingPlan` and the batch
+        divisibility are all resolved against temporaries, and any
+        refusal raises :class:`~..runtime.elastic.ElasticResizeError`
+        with the live state untouched (the dp=8→3 case).  Only then are
+        params / opt_state / step / rng moved via
+        ``parallel/redistribute.redistribute_tree`` (bounded waves,
+        never a replicated intermediate) while the per-replica buffers
+        (residual / grad_accum) are rebuilt as fresh zeros for the new
+        world — exactly as the checkpoint-restore path does.
+
+        Afterwards the trainer is compiled for the new mesh and a
+        ``fit(..., ckpt_path="live")`` continues from the live state and
+        counters.  Returns the redistribution stats (bytes moved,
+        waves, seconds).  Emits ``resize_begin``/``resize_end`` and
+        accounts the downtime as the goodput ledger's ``resize`` phase
+        when a perf observatory is attached."""
+        from ..parallel import plan as plan_lib
+        from ..parallel import redistribute as redistribute_lib
+        from ..runtime.elastic import ElasticResizeError
+
+        if self._state is None or self.module is None \
+                or self._example_batch is None:
+            raise ElasticResizeError(
+                "resize_in_memory needs a fitted trainer with live state "
+                "(call fit() first)")
+        module, state = self.module, self._state
+        old_mesh = self._mesh
+        old_dp = mesh_lib.data_parallel_size(old_mesh)
+        t0 = time.perf_counter()
+
+        # -- plan the new topology against temporaries (refusals here
+        #    leave the run exactly as it was) -------------------------
+        cfg = self.accelerator.mesh_config
+        n_fsdp = cfg.fsdp if cfg.fsdp and cfg.fsdp > 0 else 1
+        if num_workers < 1 or num_workers % n_fsdp:
+            raise ElasticResizeError(
+                f"cannot resize to {num_workers} batch shards: not "
+                f"divisible by the mesh's fsdp={n_fsdp} axis")
+        import copy
+        import dataclasses as _dc
+        accelerator = copy.copy(self.accelerator)
+        accelerator.mesh_config = _dc.replace(cfg,
+                                              data=num_workers // n_fsdp)
+        accelerator._mesh = None
+        if getattr(accelerator, "num_workers", None) is not None:
+            accelerator.num_workers = num_workers
+        try:
+            new_mesh = accelerator.build_mesh()
+            new_plan = plan_lib.build_plan(
+                new_mesh, accelerator, module, state, self._tx,
+                grad_compression=self.grad_compression,
+                shard_optimizer_state=self.shard_optimizer_state,
+                report_fallbacks=False)
+        except ValueError as e:
+            raise ElasticResizeError(
+                f"cannot re-plan the live state onto a {num_workers}-wide "
+                f"mesh: {e}") from e
+        new_dp = mesh_lib.data_parallel_size(new_mesh)
+        # the batch contract the next step must satisfy: same typed
+        # refusal _check_batch raises on an elastic resume, but BEFORE
+        # any state moved
+        batch_leaves = jax.tree.leaves(self._example_batch)
+        dp_local = max(1, new_dp // jax.process_count())
+        for leaf in batch_leaves:
+            n = leaf.shape[0] if getattr(leaf, "ndim", 0) else 0
+            if n and n % dp_local:
+                raise ElasticResizeError(
+                    f"per-process batch dim {n} is not divisible by the "
+                    f"resized local data-parallel size {dp_local} "
+                    f"(dp {old_dp}→{new_dp}); this run cannot continue "
+                    f"at that world size")
+
+        telemetry.emit("resize_begin", old_world=old_dp,
+                       new_world=new_dp, step=self.global_step)
+        # -- commit the topology, rebuild buffers, recompile ----------
+        old_state = state
+        self.accelerator = accelerator
+        self._mesh = new_mesh
+        residual, grad_accum = (None, None)
+        if self.grad_compression is not None:
+            residual, grad_accum = self._fresh_exchange_buffers(
+                module, state.params, new_mesh)
+        template = state.replace(residual=residual, grad_accum=grad_accum)
+        self._compile(module, template, self._example_batch)
+        sh = self._state_shardings
+
+        # -- redistribute the live core through bounded waves ---------
+        kwargs = {} if max_bytes is None else {"max_bytes": max_bytes}
+        (step, params, opt_state, rng), stats = \
+            redistribute_lib.redistribute_tree(
+                (old_state.step, old_state.params, old_state.opt_state,
+                 old_state.rng),
+                (sh.step, sh.params, sh.opt_state, sh.rng),
+                donate=True, **kwargs)
+        new_state = old_state.replace(
+            step=step, params=params, opt_state=opt_state, rng=rng,
+            residual=(None if residual is None
+                      else jax.device_put(residual, sh.residual)),
+            grad_accum=(None if grad_accum is None
+                        else jax.device_put(grad_accum, sh.grad_accum)))
+        self._state = new_state
+        self.module.params = new_state.params
+        self._resumed_world_resize = (old_dp, new_dp)
+        # per-replica device caches sized for the old world are stale
+        self._device_cache = None
+        self._epoch_scan_fn = None
+
+        seconds = time.perf_counter() - t0
+        stats = dict(stats, old_world=old_dp, new_world=new_dp,
+                     seconds=seconds)
+        if self.perf is not None and getattr(self.perf, "goodput", None) \
+                is not None:
+            # priced against restart/ckpt in goodput_fraction: the
+            # in-memory path's downtime is a first-class overhead phase
+            self.perf.goodput.account("resize", seconds)
+        telemetry.emit("resize_end", old_world=old_dp, new_world=new_dp,
+                       bytes_moved=stats["bytes_moved"],
+                       waves=stats["waves"], seconds=seconds)
+        log.warning("in-memory resize dp %d→%d: %d bytes moved in %d "
+                    "wave(s), %.3fs", old_dp, new_dp,
+                    stats["bytes_moved"], stats["waves"], seconds)
+        return stats
 
     def _compile(self, module: TpuModule, state: TrainState, example_batch):
         from ..parallel import collectives as collectives_lib
@@ -1831,11 +1992,25 @@ class Trainer:
                    ) -> None:
         self.accelerator.validate_process_topology()
         t0 = time.perf_counter()
+        live_resume = ckpt_path == "live"
+        if live_resume and (self._state is None or self.module is None):
+            raise ValueError(
+                "ckpt_path='live' continues from in-memory state; call "
+                "fit() (and optionally resize_in_memory()) first")
         self.fitting = True
         self.should_stop = False
-        self.current_epoch = 0
-        self.epochs_completed = 0
-        self.global_step = 0
+        if not live_resume:
+            self.current_epoch = 0
+            self.epochs_completed = 0
+            self.global_step = 0
+        else:
+            # a live continuation KEEPS its counters, but like a
+            # checkpoint restore it re-enters the epoch that was cut
+            # short: only COMPLETED epochs count, so the sampler replays
+            # the interrupted epoch's permutation rather than skipping
+            # to the next one (keeps the live path's trajectory
+            # identical to the restore path's)
+            self.current_epoch = self.epochs_completed
         self._last_val_step = -1  # stale values skip epoch-end validation
         self.module = module
         module.trainer = self
@@ -1877,67 +2052,48 @@ class Trainer:
                 self._val_loader._inject_sampler(shuffle=False, **kwargs)
 
         # state init / restore
-        rng = rng_from_seed(self.seed)
-        init_rng, state_rng = jax.random.split(rng)
-        self._tx = self._build_tx(module)
-        # a module that already carries weights (prior fit / manual load)
-        # continues from them -- the reference's re-hydrated driver model
-        # behaves the same way on a second fit (ray_ddp.py:185-189)
-        init_params = (module.params if module.params is not None
-                       else module.init_params(init_rng))
-        state = TrainState.create(init_params, self._tx, state_rng)
-        if self.grad_compression is not None:
-            from ..parallel import collectives as collectives_lib
-            n_dp = mesh_lib.data_parallel_size(self._mesh)
-            # the exchange regime decides the buffer shapes, so the param
-            # layout is probed BEFORE the residual state exists (quiet:
-            # _compile's authoritative resolution emits the fallback
-            # telemetry once); fsdp-sharded params get shard-local (1/N)
-            # residuals and param-shaped (post-exchange) accumulators —
-            # model-parallel shardings refuse typed right here
-            param_sh = self.accelerator.param_shardings(
-                self._mesh, init_params, module=module,
-                report_fallbacks=False)
-            fsdp_mode = any(
-                collectives_lib.fsdp_shard_dim(s) is not None
-                for s in jax.tree.leaves(param_sh))
-            if fsdp_mode:
-                # scan-gathered leaves never ride the quantized exchange
-                # (their reduce-scatter is the in-scan gather's exact
-                # transpose), so they get residual placeholders
-                _, scanned = self._resolve_gather_mode(
-                    module, init_params, param_sh, quiet=True)
-                state = state.replace(
-                    residual=collectives_lib.fsdp_residual_zeros(
-                        init_params, param_sh, self._exchange_cfg,
-                        scanned=scanned),
-                    grad_accum=(jax.tree.map(
-                        lambda p: jnp.zeros(p.shape, jnp.float32),
-                        init_params)
-                        if self.accumulate_grad_batches > 1 else None))
-            else:
-                state = state.replace(
-                    residual=collectives_lib.residual_zeros(
-                        init_params, n_dp, self._exchange_cfg),
-                    grad_accum=(collectives_lib.accum_zeros(init_params,
-                                                            n_dp)
-                                if self.accumulate_grad_batches > 1
-                                else None))
+        if live_resume:
+            # continue from the LIVE state (a prior fit, possibly after
+            # resize_in_memory): no fresh TrainState, no disk read —
+            # self._tx is kept because the live opt_state was built
+            # against it
+            state = self._state
+        else:
+            rng = rng_from_seed(self.seed)
+            init_rng, state_rng = jax.random.split(rng)
+            self._tx = self._build_tx(module)
+            # a module that already carries weights (prior fit / manual
+            # load) continues from them -- the reference's re-hydrated
+            # driver model behaves the same way on a second fit
+            # (ray_ddp.py:185-189)
+            init_params = (module.params if module.params is not None
+                           else module.init_params(init_rng))
+            state = TrainState.create(init_params, self._tx, state_rng)
+            if self.grad_compression is not None:
+                residual, grad_accum = self._fresh_exchange_buffers(
+                    module, init_params, self._mesh)
+                state = state.replace(residual=residual,
+                                      grad_accum=grad_accum)
         for c in self.callbacks:
             c.setup(self, module, "fit")
-        if ckpt_path == "last":
-            # crash-recovery anchor: resume from the newest checkpoint under
-            # the run dir, or start fresh when none exists yet (capability
-            # the reference lacks, SURVEY.md §5.4)
-            ckpt_path = ckpt_lib.latest_checkpoint(self.default_root_dir)
-            if ckpt_path is None:
-                log.warning("ckpt_path='last': no checkpoint under %s; "
-                            "starting fresh", self.default_root_dir)
-        if ckpt_path is not None:
-            with self._perf_phase("ckpt"):  # restore cost is a phase too
-                state = self._restore(ckpt_path, state)
+        if not live_resume:
+            if ckpt_path == "last":
+                # crash-recovery anchor: resume from the newest
+                # checkpoint under the run dir, or start fresh when none
+                # exists yet (capability the reference lacks, SURVEY.md
+                # §5.4)
+                ckpt_path = ckpt_lib.latest_checkpoint(
+                    self.default_root_dir)
+                if ckpt_path is None:
+                    log.warning("ckpt_path='last': no checkpoint under "
+                                "%s; starting fresh",
+                                self.default_root_dir)
+            if ckpt_path is not None:
+                with self._perf_phase("ckpt"):  # restore cost is a phase
+                    state = self._restore(ckpt_path, state)
 
         example_batch = next(iter(train_loader))
+        self._example_batch = example_batch
         self._check_batch(example_batch)
         self._build_device_cache(train_loader)
         self._compile(module, state, example_batch)

@@ -266,8 +266,10 @@ def accum_zeros(params, n: int):
 
 def stacked_shardings(mesh: Mesh, tree):
     """NamedShardings for [n, ...]-stacked per-replica trees (residuals,
-    accumulators): dim 0 over the batch axes, rest replicated."""
-    sh = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+    accumulators); the layout is authored in ``plan.py`` (the single
+    spec-producing module — see stacked_replica_spec)."""
+    from . import plan as plan_lib
+    sh = plan_lib.stacked_replica_sharding(mesh)
     return jax.tree.map(lambda _: sh, tree)
 
 
@@ -798,14 +800,11 @@ def build_scan_local_grads(mesh: Mesh, value_and_grad_fn, batch_spec,
 # ZeRO-1 optimizer-state sharding                                        #
 # --------------------------------------------------------------------- #
 def zero1_param_sharding(mesh: Mesh, leaf) -> NamedSharding:
-    """ZeRO-1 layout for one param-shaped leaf: dim 0 sharded over the
-    batch axes when divisible, replicated otherwise (small biases/scales
-    are not worth a ragged layout)."""
-    n = dp_size(mesh)
-    if (hasattr(leaf, "ndim") and leaf.ndim >= 1 and n > 1
-            and leaf.shape[0] % n == 0):
-        return NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
-    return NamedSharding(mesh, P())
+    """ZeRO-1 layout for one param-shaped leaf; the layout decision is
+    authored in ``plan.py`` (zero1_spec) — this wrapper survives for the
+    exchange-side callers and tests."""
+    from . import plan as plan_lib
+    return plan_lib.zero1_sharding(mesh, leaf)
 
 
 def zero1_opt_shardings(mesh: Mesh, tx, opt_state, params):

@@ -1,0 +1,214 @@
+"""Declarative sharding plans: ONE producer for every state layout.
+
+ROADMAP item 4 (and the veScale argument in PAPERS.md): layouts used to
+be computed inline at four sites — the ZeRO-1 logic in
+``collectives.py``, the FSDP leaf heuristic in ``sharding.py``, the
+per-replica buffer layouts in ``accelerators/base.py`` and the
+resolution glue in ``core/trainer.py``.  Elastic resharding needs the
+layout as a *value* — something that can be built for a mesh the run is
+not on yet, diffed against the live one, and handed to
+``parallel/redistribute.py`` — so the spec AUTHORING moves here:
+
+- the leaf-level authors (:func:`replicated_spec`,
+  :func:`stacked_replica_spec`, :func:`zero1_spec`,
+  :func:`fsdp_leaf_spec`) own the PartitionSpec literals that used to
+  live in the four sites above (``SHARDING_INVENTORY.json`` is the
+  audit trail; the sharding-inventory lint gates drift, and this module
+  is the inventoried authoring site for NEW specs);
+- :class:`ShardingPlan` (built by :func:`build_plan`) is the resolved
+  product for one ``(mesh, module, optimizer, config)`` tuple: the
+  TrainState-shaped sharding tree plus the derived compressed-FSDP /
+  ZeRO-1 layouts the trainer used to compute as side effects.
+
+Operational ``shard_map`` in/out specs (the collectives' exchange
+bodies, ulysses/ring/pipeline) stay where the collective lives — those
+are *execution* specs tied to a body, not state layouts, and they are
+already inventoried per module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+from ..utils.logging import log
+
+# Leaves below this size stay replicated under the FSDP heuristic: the
+# layout bookkeeping costs more than the memory it would save.
+FSDP_MIN_LEAF_SIZE = 2 ** 12
+
+
+# --------------------------------------------------------------------- #
+# Leaf-level spec authors (the layout literals live HERE)                #
+# --------------------------------------------------------------------- #
+def replicated_spec() -> P:
+    """Fully replicated leaf."""
+    return P()
+
+
+def stacked_replica_spec() -> P:
+    """[n, ...]-stacked per-replica trees (residuals, accumulators):
+    dim 0 over the batch axes, rest replicated."""
+    return P(mesh_lib.BATCH_AXES)
+
+
+def zero1_spec(mesh: Mesh, leaf: Any) -> P:
+    """ZeRO-1 layout for one param-shaped leaf: dim 0 sharded over the
+    batch axes when divisible, replicated otherwise (small biases and
+    scales are not worth a ragged layout)."""
+    n = mesh_lib.data_parallel_size(mesh)
+    if (hasattr(leaf, "ndim") and leaf.ndim >= 1 and n > 1
+            and leaf.shape[0] % n == 0):
+        return P(mesh_lib.BATCH_AXES)
+    return P()
+
+
+def fsdp_leaf_spec(mesh: Mesh, leaf: Any,
+                   min_size: int = FSDP_MIN_LEAF_SIZE) -> Optional[P]:
+    """Heuristic FSDP layout for one leaf: the largest fsdp-divisible
+    dim sharded over the ``fsdp`` axis.  ``P()`` when the mesh has no
+    fsdp axis or the leaf is too small to bother; ``None`` when the
+    leaf is large enough to WANT sharding but no dim divides — the
+    caller decides how to surface that fallback (``sharding.py`` routes
+    it into the ``fsdp_fallback`` telemetry event)."""
+    fsdp = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
+    if fsdp == 1 or not hasattr(leaf, "shape") or leaf.size < min_size:
+        return P()
+    # pick the largest divisible dim
+    dims = sorted(range(leaf.ndim), key=lambda d: -leaf.shape[d])
+    for d in dims:
+        if leaf.shape[d] % fsdp == 0:
+            spec = [None] * leaf.ndim
+            spec[d] = mesh_lib.FSDP_AXIS
+            return P(*spec)
+    return None
+
+
+# NamedSharding conveniences over the authors above ---------------------
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, replicated_spec())
+
+
+def stacked_replica_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, stacked_replica_spec())
+
+
+def zero1_sharding(mesh: Mesh, leaf: Any) -> NamedSharding:
+    return NamedSharding(mesh, zero1_spec(mesh, leaf))
+
+
+# --------------------------------------------------------------------- #
+# The resolved plan                                                      #
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class ShardingPlan:
+    """The resolved layout for one ``(mesh, module, tx, config)`` tuple.
+
+    ``state_shardings`` is TrainState-shaped (NamedSharding per leaf) —
+    what ``jit``'s in/out shardings and ``jax.device_put`` consume.
+    ``fsdp_param_shardings`` is the param tree when the compressed
+    exchange runs in the FSDP (reduce-scatter/all-gather) regime, else
+    None; ``zero1_update_shardings`` is the param-shaped constraint tree
+    when ZeRO-1 optimizer-state sharding re-layouts the moments.
+
+    ``per_replica_fields`` name the TrainState fields that are NOT
+    redistributable across world sizes: residuals and accumulators are
+    per-replica error/accumulation state whose leading dim IS the old
+    world, so a resize rebuilds them as fresh zeros for the new world —
+    exactly what the checkpoint-restore path does
+    (``Trainer._reset_mismatched_exchange_buffers``)."""
+
+    mesh: Mesh
+    dp: int
+    fsdp: int
+    state_shardings: Any
+    fsdp_param_shardings: Any = None
+    zero1_update_shardings: Any = None
+    per_replica_fields: Tuple[str, ...] = ("residual", "grad_accum")
+
+    def describe(self) -> dict:
+        """Schema summary (docs/API.md "plan schema"; also handy in
+        telemetry payloads): world sizes + per-field leaf layout
+        counts."""
+        out = {"dp": self.dp, "fsdp": self.fsdp,
+               "per_replica_fields": list(self.per_replica_fields),
+               "fields": {}}
+        for field in ("params", "opt_state", "residual", "grad_accum"):
+            tree = getattr(self.state_shardings, field, None)
+            leaves = [s for s in jax.tree.leaves(tree)
+                      if isinstance(s, NamedSharding)]
+            if not leaves:
+                continue
+            out["fields"][field] = {
+                "leaves": len(leaves),
+                "replicated": sum(s.is_fully_replicated for s in leaves),
+                "sharded": sum(not s.is_fully_replicated for s in leaves),
+            }
+        out["regime"] = ("compressed_fsdp"
+                         if self.fsdp_param_shardings is not None
+                         else ("zero1"
+                               if self.zero1_update_shardings is not None
+                               else "dp"))
+        return out
+
+
+def build_plan(mesh: Mesh, accelerator: Any, module: Any, state: Any,
+               tx: Any, *, grad_compression: Optional[str] = None,
+               shard_optimizer_state: bool = False,
+               report_fallbacks: bool = True) -> ShardingPlan:
+    """Resolve the full state layout for ``mesh`` — the logic that used
+    to live inline in ``Trainer._resolve_state_shardings``.
+
+    The accelerator supplies the base layout (logical rules / FSDP
+    heuristic / replicated, plus the stacked per-replica buffers); on
+    top of that: ``grad_compression`` with fsdp-sharded params locks in
+    the compressed-FSDP regime (model-parallel layouts refuse typed via
+    ``fsdp_shard_dim``), and ``shard_optimizer_state`` re-layouts
+    replicated-param optimizer moments ZeRO-1 style.
+
+    Pure with respect to the live state: building a plan for a mesh the
+    run is NOT on yet (the elastic resize path) mutates nothing, so a
+    refusal raised here leaves the run's current layout intact."""
+    from . import collectives as collectives_lib
+
+    state_sh = accelerator.state_shardings(
+        mesh, state, module=module, tx=tx,
+        report_fallbacks=report_fallbacks)
+    params_replicated = all(
+        s.is_fully_replicated for s in jax.tree.leaves(state_sh.params))
+    fsdp_param_sh = None
+    if grad_compression is not None and not params_replicated:
+        # compressed FSDP: fsdp-sharded params ride the quantized
+        # reduce-scatter-into-owner exchange (ZeRO-2/3,
+        # collectives.build_fsdp_exchange); any model-parallel
+        # (tensor/sequence/pipeline) sharding refuses typed — those
+        # gradients are not replicas over the batch axes, so a
+        # quantized replica exchange of them would be silently wrong
+        for s in jax.tree.leaves(state_sh.params):
+            collectives_lib.fsdp_shard_dim(s)  # raises typed on TP
+        fsdp_param_sh = state_sh.params
+    zero1_update_sh = None
+    if shard_optimizer_state:
+        if not params_replicated:
+            log.warning(
+                "shard_optimizer_state=True with sharded params: the "
+                "optimizer state already inherits the FSDP/TP layout; "
+                "ZeRO-1 re-sharding is skipped")
+        else:
+            opt_sh = collectives_lib.zero1_opt_shardings(
+                mesh, tx, state.opt_state, state.params)
+            if opt_sh is not None:
+                state_sh = state_sh.replace(opt_state=opt_sh)
+                zero1_update_sh = collectives_lib.zero1_update_shardings(
+                    mesh, state.params)
+    return ShardingPlan(
+        mesh=mesh,
+        dp=mesh_lib.data_parallel_size(mesh),
+        fsdp=mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS),
+        state_shardings=state_sh,
+        fsdp_param_shardings=fsdp_param_sh,
+        zero1_update_shardings=zero1_update_sh)

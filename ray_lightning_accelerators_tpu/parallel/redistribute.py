@@ -1,0 +1,176 @@
+"""In-memory shard redistribution with bounded peak memory.
+
+The primitive behind live elastic resharding ("Memory-efficient array
+redistribution through portable collective communication", PAPERS.md):
+given a live sharded pytree and the target layout from a new
+:class:`~.plan.ShardingPlan`, move the shards where the new plan wants
+them WITHOUT a checkpoint round-trip and WITHOUT ever materializing a
+replicated copy of the tree.
+
+Mechanics: a cross-sharding ``jax.device_put`` lowers to a collective
+permutation / slice-exchange program (XLA's resharding transfer), so
+each leaf goes old-layout → new-layout directly — no gather to host, no
+replicated intermediate.  Peak transfer memory is bounded by moving the
+tree in **waves**: leaves are greedily packed into groups whose summed
+bytes stay under ``max_bytes`` (one oversized leaf forms its own wave —
+a single leaf's transfer is the irreducible floor), and each wave is
+blocked to completion (and optionally donated: source shards freed)
+before the next starts.  So at any instant at most
+
+    live tree  +  min(max_bytes, largest leaf)  of in-flight transfer
+
+is resident, instead of live + full second copy.
+
+The byte accounting is analytic in the ``wire_bytes_per_step`` style
+(collectives.py): for each leaf, the exact number of bytes whose OWNER
+changes between the two layouts, computed from the shardings'
+device→index maps — zero for leaves whose placement is unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+# one wave of in-flight resharding transfer; ~a few fused transfer
+# buffers on a 16GB part, irrelevant on the CPU test mesh
+DEFAULT_WAVE_BYTES = 256 * 1024 * 1024
+
+__all__ = ["DEFAULT_WAVE_BYTES", "leaf_moved_bytes", "resharding_bytes",
+           "redistribute_tree", "wave_schedule"]
+
+
+def _nbytes(leaf: Any) -> int:
+    size = int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+    itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+    if itemsize is None:
+        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+    return size * int(itemsize)
+
+
+def _slice_bounds(idx: Tuple, shape: Tuple[int, ...]) -> List[Tuple[int,
+                                                                    int]]:
+    """Normalize a devices_indices_map entry (tuple of slices, possibly
+    shorter than ndim / with None endpoints) to [start, stop) per dim."""
+    bounds = []
+    for d, dim in enumerate(shape):
+        sl = idx[d] if d < len(idx) else slice(None)
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        bounds.append((start, stop))
+    return bounds
+
+
+def _overlap_elems(a: Tuple, b: Tuple, shape: Tuple[int, ...]) -> int:
+    """Element count of the intersection of two index-tuple regions."""
+    if not shape:
+        return 1  # scalars: any two "slices" fully overlap
+    vol = 1
+    for (a0, a1), (b0, b1) in zip(_slice_bounds(a, shape),
+                                  _slice_bounds(b, shape)):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if hi <= lo:
+            return 0
+        vol *= hi - lo
+    return vol
+
+
+def leaf_moved_bytes(leaf: Any, new_sharding: Any) -> int:
+    """Bytes of ``leaf`` that must cross a device boundary to satisfy
+    ``new_sharding``: for every device in the target layout, the part of
+    its new shard NOT already resident there under the leaf's current
+    sharding.  A host (numpy) leaf counts in full — everything is a
+    transfer.  Exact for slice-shaped layouts (every NamedSharding)."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    old = getattr(leaf, "sharding", None)
+    nbytes = _nbytes(leaf)
+    if old is None:
+        return nbytes
+    if old == new_sharding:
+        return 0
+    itemsize = nbytes // max(1, int(np.prod(shape or (1,))))
+    try:
+        old_map = old.devices_indices_map(shape)
+        new_map = new_sharding.devices_indices_map(shape)
+    except Exception:
+        # exotic sharding without an index map: assume a full move
+        return nbytes
+    moved = 0
+    for dev, new_idx in new_map.items():
+        need = _overlap_elems(new_idx, new_idx, shape)
+        have = (_overlap_elems(old_map[dev], new_idx, shape)
+                if dev in old_map else 0)
+        moved += max(0, need - have) * itemsize
+    return moved
+
+
+def resharding_bytes(tree: Any, new_shardings: Any) -> int:
+    """Analytic redistribution byte count for a whole pytree (the
+    ``wire_bytes_per_step``-style number resize telemetry reports)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    sh_leaves = treedef.flatten_up_to(new_shardings)
+    return sum(leaf_moved_bytes(x, s) for x, s in zip(leaves, sh_leaves))
+
+
+def wave_schedule(sizes: Sequence[int],
+                  max_bytes: int = DEFAULT_WAVE_BYTES) -> List[List[int]]:
+    """Greedy wave packing: leaf indices grouped so each group's summed
+    bytes stay under ``max_bytes`` (an oversized leaf gets its own
+    wave).  Order-preserving — no benefit to reordering, and a stable
+    schedule keeps the transfer deterministic across ranks."""
+    waves: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, sz in enumerate(sizes):
+        if cur and cur_bytes + sz > max_bytes:
+            waves.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += sz
+    if cur:
+        waves.append(cur)
+    return waves
+
+
+def redistribute_tree(tree: Any, new_shardings: Any, *,
+                      max_bytes: int = DEFAULT_WAVE_BYTES,
+                      donate: bool = False
+                      ) -> Tuple[Any, Dict[str, Any]]:
+    """Move a live sharded pytree to ``new_shardings`` in bounded waves.
+
+    Returns ``(new_tree, stats)`` where stats carries the analytic
+    ``bytes_moved`` (owner-crossing bytes, see :func:`leaf_moved_bytes`),
+    ``bytes_total`` (tree size), ``leaves``, ``waves`` and measured
+    ``seconds``.  ``donate=True`` donates each source shard to its
+    transfer (``jax.device_put(..., donate=True)`` — the runtime frees
+    or aliases source buffers as each wave lands, never unsafely) —
+    peak memory drops to ~one tree + one wave, at the price that a
+    failure mid-way leaves the SOURCE tree partially consumed (callers
+    then fall back to the checkpoint chain; the elastic integration
+    validates everything refusable BEFORE the first wave so typed
+    refusals never reach this point)."""
+    t0 = time.monotonic()
+    leaves, treedef = jax.tree.flatten(tree)
+    sh_leaves = treedef.flatten_up_to(new_shardings)
+    sizes = [_nbytes(x) for x in leaves]
+    moved = sum(leaf_moved_bytes(x, s) for x, s in zip(leaves, sh_leaves))
+    out: List[Optional[Any]] = [None] * len(leaves)
+    waves = wave_schedule(sizes, max_bytes=max_bytes)
+    for wave in waves:
+        placed = [jax.device_put(leaves[i], sh_leaves[i], donate=donate)
+                  for i in wave]
+        jax.block_until_ready(placed)
+        for i, arr in zip(wave, placed):
+            out[i] = arr
+    stats = {
+        "bytes_moved": int(moved),
+        "bytes_total": int(sum(sizes)),
+        "leaves": len(leaves),
+        "waves": len(waves),
+        "max_wave_bytes": int(max_bytes),
+        "seconds": time.monotonic() - t0,
+    }
+    return jax.tree.unflatten(treedef, out), stats

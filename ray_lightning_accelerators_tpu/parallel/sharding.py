@@ -180,21 +180,19 @@ def infer_fsdp_shardings(params, mesh: Mesh, min_size: int = 2 ** 12,
     sharding whose dims all fail to divide the fsdp axis — the silent
     loss-of-FSDP-savings case observability wants surfaced (the
     accelerator routes it into a telemetry event + profiler counter).
+
+    The per-leaf layout choice is authored in ``plan.py``
+    (fsdp_leaf_spec) — this function is the tree-mapping + fallback
+    plumbing around it.
     """
-    fsdp = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
+    from . import plan as plan_lib
 
     def one(path, leaf):
-        if fsdp == 1 or not hasattr(leaf, "shape") or leaf.size < min_size:
-            return NamedSharding(mesh, P())
-        # pick the largest divisible dim
-        dims = sorted(range(leaf.ndim), key=lambda d: -leaf.shape[d])
-        for d in dims:
-            if leaf.shape[d] % fsdp == 0:
-                spec = [None] * leaf.ndim
-                spec[d] = mesh_lib.FSDP_AXIS
-                return NamedSharding(mesh, P(*spec))
-        if on_fallback is not None:
-            on_fallback(jax.tree_util.keystr(path), leaf)
-        return NamedSharding(mesh, P())
+        spec = plan_lib.fsdp_leaf_spec(mesh, leaf, min_size=min_size)
+        if spec is None:  # wanted sharding, nothing divides
+            if on_fallback is not None:
+                on_fallback(jax.tree_util.keystr(path), leaf)
+            spec = plan_lib.replicated_spec()
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, params)

@@ -484,6 +484,9 @@ class ActorPool:
         envs = env_per_worker or [{} for _ in range(num_workers)]
         assert len(envs) == num_workers
         self.workers: List[Any] = []
+        # env overlays of ranks removed by drop(), kept so revive() can
+        # re-place a host that came back (the elastic grow path)
+        self._dropped_envs: Dict[int, Dict[str, str]] = {}
         try:
             if agents:
                 from .agent import RemoteWorker, assign_agents
@@ -589,17 +592,12 @@ class ActorPool:
             log.warning("restarted dead workers: %s", restarted)
         return restarted
 
-    def find_lost(self, timeout_s: float = 120.0) -> List[int]:
-        """Ranks that fail a trivial round-trip dispatch within
-        ``timeout_s`` — the "is this host actually back?" probe run after
-        a restart.  A permanently lost rank (host gone; chaos
-        ``lost@rankN``) respawns and immediately dies, failing its probe
-        future fast via the collector's EOF path; healthy ranks answer as
-        soon as their interpreter finishes booting.  The timeout is
-        shared across the whole probe sweep (the dispatches run in
-        parallel)."""
+    def _probe_sweep(self, workers, timeout_s: float) -> List[int]:
+        """Parallel round-trip probes; returns the ranks that failed.
+        The timeout is shared across the whole sweep (the dispatches run
+        in parallel)."""
         import time as _time
-        futs = [(w.rank, w.execute(_probe_ok)) for w in self.workers]
+        futs = [(w.rank, w.execute(_probe_ok)) for w in workers]
         deadline = _time.monotonic() + timeout_s
         lost = []
         for rank, f in futs:
@@ -609,6 +607,40 @@ class ActorPool:
                 log.warning("probe of worker %d failed: %s", rank, e)
                 lost.append(rank)
         return lost
+
+    def find_lost(self, timeout_s: float = 120.0, classify: bool = False):
+        """Ranks that fail a trivial round-trip dispatch within
+        ``timeout_s`` — the "is this host actually back?" probe run after
+        a restart.  A permanently lost rank (host gone; chaos
+        ``lost@rankN``) respawns and immediately dies, failing its probe
+        future fast via the collector's EOF path; healthy ranks answer as
+        soon as their interpreter finishes booting.
+
+        ``classify=True`` distinguishes a REVIVABLE rank from a gone one
+        (the elastic grow path): each failed rank gets one restart + one
+        re-probe — a host that came back mid-sweep (chaos ``rejoin``
+        clearing its ``lost`` marker) lands in ``"revived"`` and stays
+        in the pool; the rest are ``"gone"``.  Returns
+        ``{"gone": [...], "revived": [...]}`` instead of the flat
+        list."""
+        lost = self._probe_sweep(self.workers, timeout_s)
+        if not classify:
+            return lost
+        if not lost:
+            return {"gone": [], "revived": []}
+        retry = [w for w in self.workers if w.rank in set(lost)]
+        for w in retry:
+            try:
+                w.restart()
+            except BaseException as e:
+                log.warning("classify restart of worker %d failed: %s",
+                            w.rank, e)
+        still_lost = set(self._probe_sweep(retry, timeout_s))
+        revived = sorted(set(lost) - still_lost)
+        if revived:
+            log.warning("lost rank(s) %s answered their re-probe; "
+                        "keeping them in the pool", revived)
+        return {"gone": sorted(still_lost), "revived": revived}
 
     def drop(self, ranks: Sequence[int]) -> List[int]:
         """Remove ``ranks`` from the pool (the elastic scale-down
@@ -621,6 +653,9 @@ class ActorPool:
         gone = set(ranks)
         dropping = [w for w in self.workers if w.rank in gone]
         for w in dropping:
+            # remember the env overlay: a dropped host that comes back
+            # can be re-placed at its old rank via revive()
+            self._dropped_envs[w.rank] = dict(getattr(w, "_env", {}) or {})
             try:
                 w.kill()
             except BaseException:
@@ -632,6 +667,45 @@ class ActorPool:
                         dropped, len(self.workers),
                         [w.rank for w in self.workers])
         return dropped
+
+    def dropped_ranks(self) -> List[int]:
+        """Ranks removed by ``drop`` whose env overlay is remembered —
+        the revival candidates the elastic grow path retries."""
+        return sorted(self._dropped_envs)
+
+    def revive(self, rank: int,
+               probe_timeout_s: float = 30.0) -> Optional[Worker]:
+        """Re-place a previously dropped rank (the elastic grow
+        primitive): spawn a fresh Worker at the SAME rank with its
+        remembered env overlay and probe it.  Returns the worker (now
+        back in the pool, inserted in rank order so logical-rank
+        dispatch stays deterministic) on success; None when the rank was
+        never dropped, the pool is agent-backed, or the host is still
+        gone (the probe failed — the spawn is killed and the rank stays
+        dropped for a later retry)."""
+        env = self._dropped_envs.get(rank)
+        if env is None:
+            return None
+        if self.workers and not isinstance(self.workers[0], Worker):
+            log.warning("revive(%d): agent-backed pools cannot re-place "
+                        "workers", rank)
+            return None
+        w = Worker(rank, dict(env), mp.get_context("spawn"))
+        if self._probe_sweep([w], probe_timeout_s):
+            try:
+                w.kill()
+            except BaseException:
+                pass
+            log.warning("revive(%d): host still gone (probe failed)",
+                        rank)
+            return None
+        del self._dropped_envs[rank]
+        self.workers.append(w)
+        self.workers.sort(key=lambda x: x.rank)
+        log.warning("revived worker rank %d; pool now %d rank(s) %s",
+                    rank, len(self.workers),
+                    [x.rank for x in self.workers])
+        return w
 
     def restart_all(self, init_hook: Optional[Callable[[], None]] = None) \
             -> List[int]:

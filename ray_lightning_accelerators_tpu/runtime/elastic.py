@@ -81,6 +81,7 @@ class ElasticRunner:
                  dispatch_deadline_s: Optional[float] = None,
                  watchdog_poll_s: Optional[float] = None,
                  allow_shrink: bool = False,
+                 resize_in_memory: bool = False,
                  min_workers: int = 1,
                  probe_timeout_s: float = 120.0,
                  max_preemptions: int = 3,
@@ -104,6 +105,22 @@ class ElasticRunner:
         dispatched work re-partitions.  ``min_workers`` floors the
         shrink.  ``max_preemptions`` bounds graceful-preemption resumes
         (which do NOT consume the failure budget).
+
+        ``resize_in_memory``: survivors of a failed attempt KEEP their
+        process (and its live in-memory state — the dispatched body is
+        expected to retain state across dispatches and redistribute it,
+        e.g. via ``Trainer.resize_in_memory`` + ``fit(ckpt_path=
+        'live')``) instead of the blanket ``restart_all``; only dead
+        ranks respawn, ``find_lost(classify=True)`` distinguishes a
+        revivable host from a gone one, and previously dropped ranks
+        are re-placed via ``pool.revive`` when their host answers again
+        (elastic GROW).  The between-attempt downtime is accounted as
+        the goodput ledger's ``resize`` phase (priced against
+        ``restart``/``ckpt`` in ``goodput_fraction``) and bracketed by
+        ``resize_begin``/``resize_end`` telemetry.  Bodies keep the
+        checkpoint chain as their fallback — when no surviving rank
+        retains usable state, an attempt resumes from disk exactly as
+        without this flag, charging the failure budget once.
 
         Hang-aware supervision runs when any of ``wedge_timeout_s``
         (stale-heartbeat threshold), ``dispatch_deadline_s`` (per-attempt
@@ -142,6 +159,11 @@ class ElasticRunner:
         # "world_size": new size})
         self.preempt_events: List[preempt_lib.Preempted] = []
         self.shrink_events: List[Dict[str, Any]] = []
+        self.resize_in_memory = resize_in_memory
+        # elastic GROW records under resize_in_memory ({"revived": ranks,
+        # "world_size": new size, "attempt": n}): a previously dropped
+        # rank whose host answers probes again is re-placed in the pool
+        self.grow_events: List[Dict[str, Any]] = []
         # driver-side notice: installed when RLA_TPU_PREEMPT_GRACE_S is
         # configured, so a driver SIGTERM ends the retry loop instead of
         # respawning workers on a host that is going away
@@ -250,6 +272,9 @@ class ElasticRunner:
         # cleared BEFORE the restart: every respawned rank rewrites its
         # spill at boot install, so the retry diffs only its own traces
         self._reset_collectives()
+        if self.resize_in_memory:
+            self._prepare_retry_in_memory(attempt)
+            return
         restarted = self.pool.restart_all(
             init_hook=None if self.allow_shrink else self.init_hook)
         log.warning("elastic attempt %d (restarted ranks %s)",
@@ -272,6 +297,65 @@ class ElasticRunner:
             log.warning("elastic scale-down: %s", event)
         if self.init_hook is not None:
             for f in self.pool.execute_all(self.init_hook):
+                f.result()
+
+    def _prepare_retry_in_memory(self, attempt: int) -> None:
+        """The ``resize_in_memory`` between-attempt path: survivors KEEP
+        their process (and whatever live state the body retained — the
+        in-memory alternative to the checkpoint round-trip), so there is
+        no ``restart_all``.  Order matters:
+
+        1. GROW — previously dropped ranks whose host answers again are
+           re-placed via ``pool.revive`` (elastic grow without touching
+           any survivor).
+        2. Dead-but-present ranks respawn in place (``restart_dead``).
+        3. SHRINK — ``find_lost(classify=True)`` separates a revivable
+           host (restart succeeded mid-probe) from a gone one; only the
+           gone ranks are dropped, floored by ``min_workers``.
+        4. ``init_hook`` runs ONLY on fresh processes (revived +
+           respawned): re-running it on a survivor would wipe the live
+           state this mode exists to preserve.
+        """
+        fresh: List[int] = []
+        for rank in self.pool.dropped_ranks():
+            w = self.pool.revive(rank, probe_timeout_s=self.probe_timeout_s)
+            if w is not None:
+                fresh.append(rank)
+        if fresh:
+            event = {"revived": sorted(fresh),
+                     "world_size": len(self.pool),
+                     "attempt": attempt + 1}
+            self.grow_events.append(event)
+            telemetry.emit("elastic_grow", **event)
+            log.warning("elastic grow: %s", event)
+        restarted = self.pool.restart_dead()
+        fresh.extend(restarted)
+        log.warning("elastic attempt %d (in-memory resize; respawned "
+                    "ranks %s)", attempt + 1, sorted(fresh))
+        if self.allow_shrink:
+            verdict = self.pool.find_lost(timeout_s=self.probe_timeout_s,
+                                          classify=True)
+            fresh.extend(verdict["revived"])
+            gone = verdict["gone"]
+            if gone:
+                survivors = len(self.pool) - len(gone)
+                if survivors < self.min_workers:
+                    raise RuntimeError(
+                        f"elastic scale-down impossible: ranks {gone} "
+                        f"are gone, leaving {survivors} < min_workers="
+                        f"{self.min_workers}")
+                dropped = self.pool.drop(gone)
+                event = {"dropped": dropped,
+                         "world_size": len(self.pool),
+                         "attempt": attempt + 1}
+                self.shrink_events.append(event)
+                telemetry.emit("elastic_shrink", **event)
+                log.warning("elastic scale-down: %s", event)
+        if self.init_hook is not None and fresh:
+            fresh_set = set(fresh)
+            targets = [w for w in self.pool.workers
+                       if w.rank in fresh_set]
+            for f in [w.execute(self.init_hook) for w in targets]:
                 f.result()
 
     def run(self, fn: Callable,
@@ -303,9 +387,23 @@ class ElasticRunner:
             if attempt > 0:
                 # restart every rank, not just dead ones: survivors of a
                 # broken collective (and watchdog-reaped wedges' peers)
-                # are alive-but-stuck and would never dequeue the retry
-                with self.goodput.measure("restart"):
+                # are alive-but-stuck and would never dequeue the retry.
+                # Under resize_in_memory survivors keep their process and
+                # the pause is an in-memory RESIZE, accounted and
+                # bracketed as such.
+                old_world = len(self.pool)
+                if self.resize_in_memory:
+                    telemetry.emit("resize_begin", old_world=old_world,
+                                   attempt=attempt + 1)
+                t_prep = time.monotonic()
+                phase = "resize" if self.resize_in_memory else "restart"
+                with self.goodput.measure(phase):
                     self._prepare_retry(attempt, failures)
+                if self.resize_in_memory:
+                    telemetry.emit(
+                        "resize_end", old_world=old_world,
+                        new_world=len(self.pool), attempt=attempt + 1,
+                        seconds=time.monotonic() - t_prep)
             watchdog: Optional[Watchdog] = None
             # built OUTSIDE the try: a mis-sized args_per_worker is a
             # configuration error, not a retryable attempt failure

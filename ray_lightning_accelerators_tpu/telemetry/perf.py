@@ -476,8 +476,11 @@ class GoodputLedger:
     a retrying, checkpointing, preemptible run spends around it.
 
     The driver-side owner (``ElasticRunner``) accounts what it can see
-    (restart/boot, backoff, wedge-detection wait); worker-side fits
-    report their interior split — ``absorb_timeline`` maps a
+    (restart/boot, backoff, wedge-detection wait — or ``resize`` when
+    the runner reshards in memory instead of restarting, so the live
+    path and the checkpoint round-trip are priced in the same ledger);
+    worker-side fits report their interior split — ``absorb_timeline``
+    maps a
     :class:`StepTimeline` snapshot's phases into categories, and
     ``absorb_profiler`` does the same from a ``Profiler`` export for
     bodies without a timeline.  ``goodput_fraction`` =

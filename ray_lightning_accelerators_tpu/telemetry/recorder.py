@@ -91,7 +91,12 @@ EVENT_KINDS = frozenset({
     "dispatch_begin", "dispatch_end",
     # supervision / retry layers (runtime/watchdog.py, runtime/elastic.py)
     "watchdog_transition", "elastic_attempt", "elastic_failure",
-    "elastic_preempt_resume", "elastic_shrink",
+    "elastic_preempt_resume", "elastic_shrink", "elastic_grow",
+    # live resize (runtime/elastic.py resize_in_memory /
+    # core/trainer.py resize_in_memory): the between-attempt in-memory
+    # resharding window — old/new world size, redistribution bytes
+    # moved, waves and wall seconds
+    "resize_begin", "resize_end",
     # serve lifecycle (serve/engine.py)
     "serve_admit", "serve_prefill", "serve_decode_step", "serve_respond",
     # serve SLO engine (serve/slo.py): a request missed its attached

@@ -31,7 +31,12 @@ loop)::
   persistent "host gone" marker under ``RLA_TPU_CHAOS_NS``: every
   respawn of that rank dies at boot, so ``pool.restart_dead()`` can
   never bring it back -- the permanently lost host that forces an
-  elastic scale-down);
+  elastic scale-down), ``rejoin`` (the grow counterpart of ``lost``:
+  the host comes back on its Nth respawn AFTER going lost --
+  ``rejoin@rank1:step3`` counts boot attempts while rank 1's lost
+  marker exists and clears it via :func:`clear_lost` on the 3rd, so
+  elastic grow (``ActorPool.revive``) is testable deterministically;
+  never fires on a dispatch);
 - target: ``rankN`` or ``all`` (worker layer), or ``replicaN`` (replica
   layer: the fault fires inside the replica's SERVE CHUNK path, counted
   per chunk via the ``chunkK`` qualifier -- only ``crash``/``hang``/
@@ -69,13 +74,41 @@ CHAOS_ENV = "RLA_TPU_CHAOS"
 CHAOS_NS_ENV = "RLA_TPU_CHAOS_NS"
 CHAOS_EXIT_CODE = 43
 LOST_EXIT_CODE = 44
-_KINDS = ("crash", "hang", "slow", "preempt", "lost")
+_KINDS = ("crash", "hang", "slow", "preempt", "lost", "rejoin")
 # faults that make sense at the replica serve-chunk layer: a replica is
 # a full process, so preempt/lost stay worker-layer kinds
 _REPLICA_KINDS = ("crash", "hang", "slow")
 
 LAYER_WORKER = "worker"
 LAYER_REPLICA = "replica"
+
+
+def _lost_markers(rank: int, ns_dir: Optional[str]) -> List[str]:
+    """Persistent 'host gone' marker files for ``rank`` under the chaos
+    namespace dir (rank-keyed, so one rank's markers never match
+    another's)."""
+    if not ns_dir or not os.path.isdir(ns_dir):
+        return []
+    suffix = f"-r{rank}.lost"
+    return [os.path.join(ns_dir, name) for name in sorted(os.listdir(ns_dir))
+            if name.endswith(suffix)]
+
+
+def clear_lost(rank: int, ns_dir: Optional[str] = None) -> List[str]:
+    """Remove ``rank``'s persistent 'host gone' markers so the next
+    respawn of that rank boots instead of dying -- the test-side grow
+    primitive (a host coming back).  ``ns_dir`` defaults to
+    ``RLA_TPU_CHAOS_NS``.  Returns the removed marker paths (empty when
+    the rank was never lost)."""
+    ns_dir = ns_dir or knobs.get_raw(CHAOS_NS_ENV) or None
+    removed = []
+    for path in _lost_markers(rank, ns_dir):
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed
 
 
 @dataclass(frozen=True)
@@ -209,11 +242,28 @@ class ChaosInjector:
         self.freeze_heartbeat = freeze_heartbeat
         self.ns_dir = ns_dir
         self._step = 0
-        if any(f.once or f.kind == "lost" for f in self.faults) \
-                and not ns_dir:
+        if any(f.once or f.kind in ("lost", "rejoin")
+               for f in self.faults) and not ns_dir:
             raise ValueError(
-                f"chaos 'once' and 'lost' faults need {CHAOS_NS_ENV} set "
-                "to a directory (the cross-restart claim store)")
+                f"chaos 'once', 'lost' and 'rejoin' faults need "
+                f"{CHAOS_NS_ENV} set to a directory (the cross-restart "
+                "claim store)")
+        # rejoin: the lost host comes back on its Kth respawn (K =
+        # the fault's stepN, default 1) — count boot attempts while this
+        # rank's lost marker(s) exist and clear them at the threshold,
+        # BEFORE the death loop below reads them
+        for f in self.faults:
+            if f.kind != "rejoin" or (f.rank is not None
+                                      and f.rank != rank):
+                continue
+            if not _lost_markers(rank, self.ns_dir):
+                continue
+            boots_path = os.path.join(self.ns_dir,
+                                      f.token(rank) + ".boots")
+            with open(boots_path, "ab") as fh:
+                fh.write(b".")
+            if os.path.getsize(boots_path) >= (f.step or 1):
+                clear_lost(rank, self.ns_dir)
         # a rank whose 'lost' fault already fired is a gone host: every
         # respawned generation dies at boot, before serving any dispatch
         for f in self.faults:
@@ -255,6 +305,10 @@ class ChaosInjector:
         """Called by the dispatch loop before executing the shipped fn."""
         self._step += 1
         for fault in self.faults:
+            if fault.kind == "rejoin":
+                # a boot-time kind (handled in __init__); its stepN
+                # counts respawns, not dispatches
+                continue
             if not fault.matches(self.rank, self._step):
                 continue
             if fault.once and not self._claim_once(fault):

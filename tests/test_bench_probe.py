@@ -78,7 +78,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     """main() with a dead backend: the death record comes FIRST, no
     accelerator bench ever ran -- and the CPU-mesh fallback benches
     (gradexchange/input_pipeline/fsdp_exchange/paged_serve/
-    mfu_overlap/perf_observatory/live_plane/serve_resilience)
+    mfu_overlap/perf_observatory/live_plane/serve_resilience/resize)
     still land REAL metric lines next
     to the death record, so the window exits 0 and the driver records
     numbers (all five earlier BENCH rounds were rc=2 with zero real
@@ -126,13 +126,17 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_serve_resilience",
         lambda: {"metric": "serve_resilience_completed_fraction",
                  "value": 1.0, "unit": "fraction", "vs_baseline": 1.0})
+    monkeypatch.setattr(
+        bench, "bench_resize",
+        lambda: {"metric": "resize_inmem_vs_ckpt_downtime_ratio",
+                 "value": 3.7, "unit": "x", "vs_baseline": 1.16})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 9
+    assert len(lines) == 10
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
@@ -143,6 +147,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert lines[6]["metric"] == "perf_observatory_phase_coverage"
     assert lines[7]["metric"] == "live_plane_scrape_validity"
     assert lines[8]["metric"] == "serve_resilience_completed_fraction"
+    assert lines[9]["metric"] == "resize_inmem_vs_ckpt_downtime_ratio"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -160,7 +165,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         "mfu_overlap_scan_vs_tree_step_time_ratio",
         "perf_observatory_phase_coverage",
         "live_plane_scrape_validity",
-        "serve_resilience_completed_fraction"]
+        "serve_resilience_completed_fraction",
+        "resize_inmem_vs_ckpt_downtime_ratio"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -177,6 +183,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_live_plane",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_serve_resilience",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_resize",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -233,6 +241,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_serve_resilience",
         lambda: {"metric": "serve_resilience_completed_fraction",
                  "value": 1.0, "unit": "fraction", "vs_baseline": 1.0})
+    monkeypatch.setattr(
+        bench, "bench_resize",
+        lambda: {"metric": "resize_inmem_vs_ckpt_downtime_ratio",
+                 "value": 3.7, "unit": "x", "vs_baseline": 1.16})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -250,7 +262,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "mfu_overlap_scan_vs_tree_step_time_ratio",
         "perf_observatory_phase_coverage",
         "live_plane_scrape_validity",
-        "serve_resilience_completed_fraction"]
+        "serve_resilience_completed_fraction",
+        "resize_inmem_vs_ckpt_downtime_ratio"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -367,6 +380,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_serve_resilience",
         lambda: {"metric": "serve_resilience_completed_fraction",
                  "value": 1.0, "unit": "fraction", "vs_baseline": 1.0})
+    monkeypatch.setattr(
+        bench, "bench_resize",
+        lambda: {"metric": "resize_inmem_vs_ckpt_downtime_ratio",
+                 "value": 3.7, "unit": "x", "vs_baseline": 1.16})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -384,6 +401,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "perf_observatory_phase_coverage" in metrics
     assert "live_plane_scrape_validity" in metrics
     assert "serve_resilience_completed_fraction" in metrics
+    assert "resize_inmem_vs_ckpt_downtime_ratio" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

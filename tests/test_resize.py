@@ -1,0 +1,527 @@
+"""Live elastic resharding (parallel/plan.py, parallel/redistribute.py,
+Trainer.resize_in_memory, ElasticRunner(resize_in_memory=True)): survive
+shrink AND grow without the checkpoint round-trip.
+
+Layers covered:
+
+1. ``ShardingPlan`` — the single producer of every placement decision —
+   and the bounded-wave redistribution primitive (schedule packing,
+   analytic moved-bytes accounting).
+2. ``Trainer.resize_in_memory`` + ``fit(ckpt_path="live")``: a dp=8→4
+   shrink whose continued run matches the checkpoint-restore path, and
+   a dp=8→3 divisibility refusal that leaves the live state untouched.
+3. The pool grow primitives (``drop``/``dropped_ranks``/``revive``,
+   ``find_lost(classify=True)``) and the chaos ``rejoin`` kind /
+   ``clear_lost`` that drive them in tests.
+4. The ElasticRunner acceptance loop: a lost rank shrinks the world in
+   memory, a rejoining host grows it back, and the descent trajectory
+   continues bit-equal — no checkpoint file read anywhere; plus the
+   fallback boundary (both ranks dying mid-attempt charges the failure
+   budget ONCE and the retry resumes from the checkpoint chain).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import (ElasticResizeError,
+                                            RayTPUAccelerator, Trainer)
+from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+from ray_lightning_accelerators_tpu.parallel import plan as plan_lib
+from ray_lightning_accelerators_tpu.parallel import redistribute as rd
+from ray_lightning_accelerators_tpu.runtime.actors import ActorPool
+from ray_lightning_accelerators_tpu.runtime.elastic import ElasticRunner
+from ray_lightning_accelerators_tpu.testing import chaos as chaos_lib
+from tests.utils import BoringModel, boring_loaders
+
+HB = 0.05
+
+pytestmark = pytest.mark.resize
+
+
+# --------------------------------------------------------------------- #
+# redistribute primitives                                               #
+# --------------------------------------------------------------------- #
+
+def test_wave_schedule_packs_under_budget_and_isolates_oversized():
+    assert rd.wave_schedule([100, 100, 100], max_bytes=250) == [[0, 1], [2]]
+    # an oversized leaf forms its own wave (the irreducible floor)
+    assert rd.wave_schedule([300, 10, 10], max_bytes=250) == [[0], [1, 2]]
+    assert rd.wave_schedule([], max_bytes=250) == []
+    # order-preserving: no reordering even when repacking would be denser
+    assert rd.wave_schedule([200, 100, 100], max_bytes=250) == [
+        [0], [1, 2]]
+
+
+def _mesh(n):
+    return mesh_lib.build_mesh(mesh_lib.MeshConfig(data=n),
+                               devices=jax.devices()[:n])
+
+
+def test_leaf_moved_bytes_analytic():
+    m8, m4 = _mesh(8), _mesh(4)
+    x = jax.device_put(jnp.zeros((16, 4), jnp.float32),
+                       plan_lib.replicated_sharding(m8))
+    # replicated -> replicated-on-a-subset: every target device already
+    # holds a full copy, nothing crosses a device boundary
+    assert rd.leaf_moved_bytes(x, plan_lib.replicated_sharding(m4)) == 0
+    # unchanged sharding: zero by the fast path
+    assert rd.leaf_moved_bytes(x, plan_lib.replicated_sharding(m8)) == 0
+    # dim0/8 -> dim0/4: device i's old 2-row block [2i, 2i+2) only
+    # overlaps its new 4-row block [4i, 4i+4) for i=0, so 14 of the 16
+    # rows cross a device boundary
+    sharded8 = jax.device_put(
+        jnp.zeros((16, 4), jnp.float32),
+        jax.sharding.NamedSharding(m8, plan_lib.zero1_spec(m8, x)))
+    moved = rd.leaf_moved_bytes(
+        sharded8, jax.sharding.NamedSharding(m4,
+                                             plan_lib.zero1_spec(m4, x)))
+    assert moved == 14 * 4 * x.dtype.itemsize
+    # a host leaf is all transfer
+    host = np.zeros((8,), np.float32)
+    assert rd.leaf_moved_bytes(
+        host, plan_lib.replicated_sharding(m4)) == host.nbytes
+
+
+def test_redistribute_tree_waves_and_stats():
+    m8, m4 = _mesh(8), _mesh(4)
+    repl8 = plan_lib.replicated_sharding(m8)
+    tree = {"a": jax.device_put(jnp.arange(64.0).reshape(16, 4), repl8),
+            "b": jax.device_put(jnp.ones((8,)), repl8)}
+    sh = {"a": plan_lib.replicated_sharding(m4),
+          "b": plan_lib.replicated_sharding(m4)}
+    # tiny max_bytes: every leaf gets its own wave
+    out, stats = rd.redistribute_tree(tree, sh, max_bytes=1)
+    assert stats["waves"] == 2 and stats["leaves"] == 2
+    assert stats["bytes_moved"] == 0  # replicated -> replicated subset
+    assert stats["bytes_total"] == 16 * 4 * 4 + 8 * 4
+    assert out["a"].sharding == sh["a"]
+    np.testing.assert_array_equal(np.asarray(out["a"]),
+                                  np.arange(64.0).reshape(16, 4))
+    assert rd.resharding_bytes(tree, sh) == 0
+
+
+# --------------------------------------------------------------------- #
+# ShardingPlan                                                          #
+# --------------------------------------------------------------------- #
+
+def _live_trainer(tmpdir, workers, max_steps, **kw):
+    return Trainer(default_root_dir=str(tmpdir),
+                   accelerator=RayTPUAccelerator(workers),
+                   max_epochs=100, max_steps=max_steps,
+                   enable_checkpointing=False, precision="f32", seed=0,
+                   log_every_n_steps=10 ** 9, **kw)
+
+
+def test_build_plan_owns_trainer_state_shardings(tmpdir):
+    trainer = _live_trainer(tmpdir, 8, max_steps=1,
+                            shard_optimizer_state=True)
+    train, _ = boring_loaders()
+    trainer.fit(BoringModel(), train)
+    plan = trainer._plan
+    assert isinstance(plan, plan_lib.ShardingPlan)
+    desc = plan.describe()
+    assert desc["dp"] == 8 and desc["fsdp"] == 1
+    assert desc["regime"] == "zero1"
+    assert "residual" in plan.per_replica_fields
+    assert "grad_accum" in plan.per_replica_fields
+    # the plan's state shardings ARE the live state's placements
+    sh = plan.state_shardings
+    assert trainer._state.params["layer"]["kernel"].sharding == \
+        sh.params["layer"]["kernel"]
+    # ZeRO-1: divisible optimizer leaves sharded dim-0 over the batch axes
+    zspec = plan_lib.zero1_spec(trainer._mesh,
+                                trainer._state.params["layer"]["kernel"])
+    assert zspec == jax.sharding.PartitionSpec(mesh_lib.BATCH_AXES)
+
+
+# --------------------------------------------------------------------- #
+# Trainer.resize_in_memory                                              #
+# --------------------------------------------------------------------- #
+
+def test_resize_in_memory_matches_checkpoint_restore(tmp_path):
+    """The same dp=8→4 shrink recovered both ways lands on the same
+    weights: run A restores a checkpoint into a fresh dp=4 trainer, run
+    B resizes the live dp=8 trainer in memory and continues with
+    ``fit(ckpt_path="live")`` — WITHOUT reading (or even having) any
+    checkpoint file."""
+    train, _ = boring_loaders()
+
+    # run A: checkpoint round-trip (needs checkpointing enabled)
+    model_a = BoringModel()
+    trainer_a = Trainer(default_root_dir=str(tmp_path / "a"),
+                        accelerator=RayTPUAccelerator(8), max_epochs=100,
+                        max_steps=2, precision="f32", seed=0,
+                        log_every_n_steps=10 ** 9)
+    trainer_a.fit(model_a, train)
+    ckpt = str(tmp_path / "mid.ckpt")
+    trainer_a.save_checkpoint(ckpt)
+    trainer_a2 = Trainer(default_root_dir=str(tmp_path / "a2"),
+                         accelerator=RayTPUAccelerator(4), max_epochs=100,
+                         max_steps=4, precision="f32", seed=0,
+                         log_every_n_steps=10 ** 9)
+    trainer_a2.fit(BoringModel(), train, ckpt_path=ckpt)
+    assert trainer_a2.global_step == 4
+
+    # run B: live in-memory resize of an identically-seeded fit
+    model_b = BoringModel()
+    trainer_b = _live_trainer(tmp_path / "b", 8, max_steps=2)
+    trainer_b.fit(model_b, train)
+    stats = trainer_b.resize_in_memory(4)
+    assert stats["old_world"] == 8 and stats["new_world"] == 4
+    assert stats["bytes_total"] > 0
+    trainer_b.max_steps = 4
+    trainer_b.fit(model_b, train, ckpt_path="live")
+    assert trainer_b.global_step == 4
+    assert mesh_lib.data_parallel_size(trainer_b._mesh) == 4
+    # run B never produced or read a checkpoint file
+    ckpts = [os.path.join(root, n)
+             for root, _, names in os.walk(str(tmp_path / "b"))
+             for n in names if n.endswith(".ckpt")]
+    assert ckpts == []
+    # weights within the elastic-resume tolerance of the restore path
+    for a, b in zip(jax.tree.leaves(jax.device_get(trainer_a2._state.params)),
+                    jax.tree.leaves(jax.device_get(trainer_b._state.params))):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-5)
+
+
+def test_resize_refusal_is_typed_and_preserves_live_state(tmpdir):
+    """dp=8→3 cannot divide the per-process batch: the refusal is a
+    typed ElasticResizeError raised BEFORE any mutation — params stay
+    bit-identical, the mesh stays dp=8, and the trainer can still
+    resize to a legal world afterwards."""
+    trainer = _live_trainer(tmpdir, 8, max_steps=2)
+    train, _ = boring_loaders()
+    trainer.fit(BoringModel(), train)
+    before = jax.device_get(trainer._state.params)
+    with pytest.raises(ElasticResizeError, match="divisible"):
+        trainer.resize_in_memory(3)
+    assert mesh_lib.data_parallel_size(trainer._mesh) == 8
+    for a, b in zip(jax.tree.leaves(before),
+                    jax.tree.leaves(jax.device_get(trainer._state.params))):
+        np.testing.assert_array_equal(a, b)
+    # surviving state is still usable: a legal resize goes through
+    stats = trainer.resize_in_memory(4)
+    assert stats["new_world"] == 4
+
+
+def test_resize_without_live_fit_refuses():
+    trainer = Trainer(accelerator=RayTPUAccelerator(8), max_steps=1,
+                      enable_checkpointing=False, precision="f32", seed=0)
+    with pytest.raises(ElasticResizeError, match="live"):
+        trainer.resize_in_memory(4)
+
+
+def test_live_resume_without_state_refuses(tmpdir):
+    trainer = _live_trainer(tmpdir, 8, max_steps=1)
+    train, _ = boring_loaders()
+    with pytest.raises(ValueError, match="live"):
+        trainer.fit(BoringModel(), train, ckpt_path="live")
+
+
+# --------------------------------------------------------------------- #
+# pool grow primitives                                                  #
+# --------------------------------------------------------------------- #
+
+def test_pool_drop_remembers_and_revive_replaces():
+    pool = ActorPool(2)
+    try:
+        for f in pool.execute_all(lambda: None):
+            f.result(timeout=120)
+        assert pool.drop([1]) == [1]
+        assert pool.dropped_ranks() == [1]
+        assert len(pool) == 1
+        w = pool.revive(1, probe_timeout_s=120.0)
+        assert w is not None and w.rank == 1
+        assert pool.dropped_ranks() == []
+        assert [x.rank for x in pool.workers] == [0, 1]
+        # the revived worker really serves dispatches
+        for f in pool.execute_all(lambda: os.getpid()):
+            assert f.result(timeout=120) > 0
+        # a rank never dropped is not revivable
+        assert pool.revive(0) is None
+    finally:
+        pool.shutdown()
+
+
+def test_find_lost_classify_revives_restartable_rank():
+    """classify=True gives a failed-probe rank one restart + re-probe:
+    a plainly killed process (host fine) comes back as ``revived`` and
+    stays in the pool; nothing is ``gone``."""
+    pool = ActorPool(2)
+    try:
+        for f in pool.execute_all(lambda: None):
+            f.result(timeout=120)
+        pool.workers[1].kill()
+        verdict = pool.find_lost(timeout_s=120.0, classify=True)
+        assert verdict == {"gone": [], "revived": [1]}
+        assert len(pool) == 2
+        for f in pool.execute_all(lambda: None):
+            f.result(timeout=120)
+    finally:
+        pool.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# chaos rejoin / clear_lost                                             #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.chaos
+def test_clear_lost_removes_markers(tmp_path):
+    ns = str(tmp_path / "ns")
+    os.makedirs(ns)
+    marker = os.path.join(ns, "lost-rank1-step2-r1.lost")
+    open(marker, "w").close()
+    assert chaos_lib.clear_lost(1, ns) == [marker]
+    assert not os.path.exists(marker)
+    assert chaos_lib.clear_lost(1, ns) == []  # idempotent
+    # rank-keyed: another rank's marker is never touched
+    other = os.path.join(ns, "lost-rank0-step2-r0.lost")
+    open(other, "w").close()
+    assert chaos_lib.clear_lost(1, ns) == []
+    assert os.path.exists(other)
+
+
+@pytest.mark.chaos
+def test_rejoin_clears_lost_marker_after_k_boots(tmp_path):
+    """``rejoin@rank1:step3`` counts BOOTS while the lost marker exists
+    and lifts it on the third: the in-process analog of a host coming
+    back after two failed respawns.  (Only the rejoin fault is
+    installed here, so the lost death loop never fires.)"""
+    ns = str(tmp_path / "ns")
+    os.makedirs(ns)
+    marker = os.path.join(ns, "lost-rank1-step2-r1.lost")
+    open(marker, "w").close()
+    faults = chaos_lib.parse_chaos("rejoin@rank1:step3")
+    for boot in range(1, 3):  # boots 1-2: marker survives
+        chaos_lib.ChaosInjector(faults, rank=1, ns_dir=ns)
+        assert os.path.exists(marker), f"boot {boot} cleared too early"
+    chaos_lib.ChaosInjector(faults, rank=1, ns_dir=ns)  # boot 3
+    assert not os.path.exists(marker)
+    # boots were counted in the namespace (crash-restart durable)
+    boots = [n for n in os.listdir(ns) if n.endswith(".boots")]
+    assert len(boots) == 1
+    assert os.path.getsize(os.path.join(ns, boots[0])) == 3
+
+
+@pytest.mark.chaos
+def test_rejoin_requires_ns_dir_and_skips_dispatch():
+    with pytest.raises(ValueError, match="rejoin"):
+        chaos_lib.ChaosInjector(chaos_lib.parse_chaos("rejoin@rank0"),
+                                rank=0, ns_dir=None)
+
+
+# --------------------------------------------------------------------- #
+# ElasticRunner(resize_in_memory=True) acceptance loops                 #
+# --------------------------------------------------------------------- #
+
+def _mem_world_body(logical_rank, world, wire_dir, total_steps):
+    """Deterministic full-batch descent that RETAINS its state in
+    process memory across dispatches (``builtins._rla_mem_state``) —
+    the stand-in for a trainer's live device state under
+    ``resize_in_memory``.  A fresh process (revived/respawned rank)
+    has no memory and resumes from ``livestate.json``, the survivor-
+    written live-state transfer file (the in-memory redistribution
+    analog — NOT a checkpoint; nothing here ever reads one).  An SPMD-
+    style barrier keyed by (step, world) makes a missing peer stall the
+    step like a torn collective."""
+    import builtins
+    import json
+    import os
+    import time
+
+    live = os.path.join(wire_dir, "livestate.json")
+    bdir = os.path.join(wire_dir, "barrier")
+    os.makedirs(bdir, exist_ok=True)
+    state = getattr(builtins, "_rla_mem_state", None)
+    resumed = "mem"
+    if state is None:
+        if os.path.exists(live):
+            with open(live) as f:
+                state = json.load(f)
+            resumed = "wire"
+        else:
+            state = {"step": 0, "w": 1.0, "worlds": []}
+            resumed = "fresh"
+    if logical_rank == 0:
+        # survivors publish their live state at dispatch entry so a
+        # freshly grown rank can join without any checkpoint
+        tmp = live + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, live)
+    hiccup = os.path.join(wire_dir, "hiccup.flag")
+    for step in range(state["step"], total_steps):
+        open(os.path.join(bdir, f"s{step}.w{world}.r{logical_rank}"),
+             "w").close()
+        deadline = time.monotonic() + 15.0
+        while not all(os.path.exists(
+                os.path.join(bdir, f"s{step}.w{world}.r{r}"))
+                for r in range(world)):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"step {step} barrier lost a peer "
+                                   f"(world={world})")
+            time.sleep(0.02)
+        w = state["w"]
+        state = {"step": step + 1, "w": w - 0.1 * (2.0 * w),
+                 "worlds": state["worlds"] + [world]}
+        builtins._rla_mem_state = state
+        if world == 1 and not os.path.exists(hiccup):
+            # engineered post-shrink failure: forces one more retry,
+            # during which the rejoining host grows the world back
+            open(hiccup, "w").close()
+            raise RuntimeError("post-shrink hiccup")
+    return (logical_rank, resumed, world, state["step"], state["w"],
+            state["worlds"])
+
+
+@pytest.mark.chaos
+@pytest.mark.preempt
+def test_chaos_shrink_then_rejoin_grows_back_without_checkpoints(tmp_path):
+    """The live-resharding acceptance loop: ``lost@rank1:step2:once``
+    shrinks
+    the world 2→1 IN MEMORY (the survivor keeps its process and state —
+    no restart_all, no checkpoint), ``rejoin@rank1:step3`` brings the
+    host back on its third respawn and ``ActorPool.revive`` grows the
+    world back to 2; the fresh rank joins from the survivor's published
+    live state.  The descent trajectory continues bit-equal to an
+    uninterrupted run, and no checkpoint file ever exists."""
+    ns = str(tmp_path / "chaos_ns")
+    wire = str(tmp_path / "wire")
+    os.makedirs(wire)
+    env = {"RLA_TPU_CHAOS": "lost@rank1:step2:once,rejoin@rank1:step3",
+           "RLA_TPU_CHAOS_NS": ns,
+           "RLA_TPU_WORKER_HEARTBEAT_S": str(HB)}
+    pool = ActorPool(2, env_per_worker=[dict(env), dict(env)])
+    failures = []
+    try:
+        # dispatch 1: both ranks run steps 0-2 at world 2, retaining
+        # their state in process memory
+        for f in pool.execute_per_worker(
+                _mem_world_body, [(r, 2, wire, 3) for r in range(2)]):
+            f.result(timeout=120)
+        runner = ElasticRunner(pool, max_failures=2, allow_shrink=True,
+                               resize_in_memory=True, min_workers=1,
+                               probe_timeout_s=120.0,
+                               on_failure=lambda a, e: failures.append(e))
+        # attempt 1: rank 1's host is lost at dispatch; rank 0's barrier
+        # raises.  Retry prep (in-memory): respawn dies (boot 1),
+        # classify restart dies (boot 2) -> gone -> shrink to 1.
+        # attempt 2: rank 0 alone runs step 3 at world 1, then the
+        # engineered hiccup fails the attempt.  Retry prep: revive(1)
+        # boots rank 1 a third time -> rejoin clears the lost marker ->
+        # grow back to 2.  attempt 3: rank 0 continues from memory,
+        # rank 1 joins from the published live state; steps 4-5 run at
+        # world 2.
+        out = runner.run(
+            _mem_world_body,
+            args_per_worker=lambda a, world: [(r, world, wire, 6)
+                                              for r in range(world)])
+        assert runner.attempts_used == 3
+        assert len(failures) == 2  # lost rank + hiccup, within budget
+        assert runner.shrink_events == [
+            {"dropped": [1], "world_size": 1, "attempt": 2}]
+        assert runner.grow_events == [
+            {"revived": [1], "world_size": 2, "attempt": 3}]
+        assert len(pool) == 2
+        by_rank = {r[0]: r for r in out}
+        assert by_rank[0][1] == "mem"    # survivor kept its process state
+        assert by_rank[1][1] == "wire"   # grown rank joined from live state
+        # the trajectory crossed shrink AND grow
+        assert by_rank[0][5] == [2, 2, 2, 1, 2, 2]
+        # bit-equal to the uninterrupted 6-step descent
+        w = 1.0
+        for _ in range(6):
+            w = w - 0.1 * (2.0 * w)
+        assert by_rank[0][4] == pytest.approx(w, abs=0.0)
+        assert by_rank[1][4] == by_rank[0][4]
+        # NO checkpoint file was ever written or read
+        assert not [n for _, _, names in os.walk(str(tmp_path))
+                    for n in names if n.endswith(".ckpt")]
+        # the pause was accounted as the goodput ledger's resize phase
+        assert runner.goodput.snapshot()["seconds"].get("resize", 0) > 0
+        # and bracketed by resize telemetry
+        from ray_lightning_accelerators_tpu.telemetry import get_recorder
+        ends = [e for e in get_recorder().events()
+                if e.get("kind") == "resize_end"]
+        assert any(e.get("data", {}).get("new_world") == 2 for e in ends)
+    finally:
+        pool.shutdown()
+
+
+def _ckpt_fallback_body(logical_rank, world, ckpt_dir, total_steps):
+    """Retains state in memory like ``_mem_world_body`` but ALSO keeps
+    the checkpoint chain current — the fallback contract: when no
+    surviving process retains state, the attempt resumes from disk."""
+    import builtins
+    import json
+    import os
+
+    path = os.path.join(ckpt_dir, "state.json")
+    state = getattr(builtins, "_rla_ckpt_mem", None)
+    resumed = "mem"
+    if state is None:
+        if os.path.exists(path):
+            with open(path) as f:
+                state = json.load(f)
+            resumed = "ckpt"
+        else:
+            state = {"step": 0, "w": 1.0}
+            resumed = "fresh"
+    for step in range(state["step"], total_steps):
+        state = {"step": step + 1, "w": state["w"] - 0.1 * (2.0 * state["w"])}
+        builtins._rla_ckpt_mem = state
+        if logical_rank == 0:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+    return (logical_rank, resumed, state["step"], state["w"])
+
+
+@pytest.mark.chaos
+@pytest.mark.preempt
+def test_mid_resize_death_falls_back_to_checkpoint_charging_once(tmp_path):
+    """Fallback boundary: EVERY rank dies mid-attempt (no surviving
+    in-memory state anywhere), so the in-memory path has nothing to
+    resize from — the retry's fresh processes resume from the
+    checkpoint chain, and the whole episode charges the failure budget
+    exactly ONCE (one failed attempt), never twice."""
+    ns = str(tmp_path / "chaos_ns")
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    env = {"RLA_TPU_CHAOS":
+           "crash@rank0:step2:once,crash@rank1:step2:once",
+           "RLA_TPU_CHAOS_NS": ns,
+           "RLA_TPU_WORKER_HEARTBEAT_S": str(HB)}
+    pool = ActorPool(2, env_per_worker=[dict(env), dict(env)])
+    failures = []
+    try:
+        # dispatch 1: both ranks run steps 0-2, keeping state.json (the
+        # checkpoint chain) current
+        for f in pool.execute_per_worker(
+                _ckpt_fallback_body, [(r, 2, ckpt, 3) for r in range(2)]):
+            f.result(timeout=120)
+        runner = ElasticRunner(pool, max_failures=1, allow_shrink=True,
+                               resize_in_memory=True, min_workers=1,
+                               probe_timeout_s=120.0,
+                               on_failure=lambda a, e: failures.append(e))
+        out = runner.run(
+            _ckpt_fallback_body,
+            args_per_worker=lambda a, world: [(r, world, ckpt, 6)
+                                              for r in range(world)])
+        # one failed attempt == one budget charge (max_failures=1 held)
+        assert len(failures) == 1
+        assert runner.attempts_used == 2
+        assert runner.shrink_events == [] and runner.grow_events == []
+        # the fresh processes resumed from the checkpoint chain
+        assert {r[1] for r in out} == {"ckpt"}
+        with open(os.path.join(ckpt, "state.json")) as f:
+            assert json.load(f)["step"] == 6
+        assert runner.goodput.snapshot()["seconds"].get("resize", 0) > 0
+    finally:
+        pool.shutdown()
