@@ -765,6 +765,16 @@ def bench_resize() -> dict:
     return _run_cpu_probe("resize_probe.py", "resize")
 
 
+def bench_pipeline() -> dict:
+    """MPMD pipeline-bubble bench (parallel/mpmd/): one 1F1B fit over 2
+    stage groups x 4 microbatches on spawned CPU workers with compute
+    sized to dominate the handoff cost; the value is the bubble accuracy
+    1 - |measured - analytic| / analytic against the analytic 1F1B
+    bubble (S-1)/(M+S-1), steady-state steps only (must be > 0.8 —
+    within 20% of analytic; see ``_run_cpu_probe``)."""
+    return _run_cpu_probe("pipeline_probe.py", "pipeline")
+
+
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
            "input_pipeline": bench_input_pipeline,
@@ -774,7 +784,7 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "perf_observatory": bench_perf_observatory,
            "live_plane": bench_live_plane,
            "serve_resilience": bench_serve_resilience,
-           "resize": bench_resize}
+           "resize": bench_resize, "pipeline": bench_pipeline}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -800,7 +810,7 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
                          "perf_observatory", "live_plane",
-                         "serve_resilience", "resize")
+                         "serve_resilience", "resize", "pipeline")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -904,7 +914,7 @@ def main() -> None:
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
                 "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory,"
-                "live_plane,serve_resilience,resize",
+                "live_plane,serve_resilience,resize,pipeline",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--gate", action="store_true",
                         help="run no benches: gate a bench window "

@@ -152,6 +152,27 @@ _register(Knob("RLA_TPU_PERF_LEAK_SAMPLES", "int", 8,
 _register(Knob("RLA_TPU_PERF_TIMELINE_RING", "int", 64,
                "per-step phase-timeline ring capacity in recent-step "
                "rows (telemetry/perf.py)"))
+_register(Knob("RLA_TPU_PIPELINE_CKPT_EVERY", "int", 1,
+               "MPMD pipeline checkpoint cadence in optimizer steps — "
+               "the replay floor after a stage-group failure "
+               "(parallel/mpmd/driver.py)"))
+_register(Knob("RLA_TPU_PIPELINE_HANDOFF_TIMEOUT_S", "float", 60.0,
+               "seconds a pipeline stage waits on a neighbor's mailbox "
+               "handoff before failing typed PipelineHandoffTimeout "
+               "(parallel/mpmd/handoff.py)"))
+_register(Knob("RLA_TPU_PIPELINE_MAX_FAILURES", "int", 2,
+               "per-stage-group failure budget: charged failures past "
+               "this raise terminal PipelineStageFailed "
+               "(parallel/mpmd/driver.py)"))
+_register(Knob("RLA_TPU_PIPELINE_STAGE", "int", None,
+               "this worker's pipeline stage index, set in each stage "
+               "group member's env overlay by the PipelineRunner — read "
+               "by chaos 'stageN' fault filtering "
+               "(parallel/mpmd/driver.py, testing/chaos.py)"))
+_register(Knob("RLA_TPU_PIPELINE_STEP_DEADLINE_S", "float", None,
+               "driver-side per-step future-gather deadline for MPMD "
+               "pipeline steps; unset derives a backstop from the "
+               "handoff timeout (parallel/mpmd/driver.py)"))
 _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
                "multi-process drain-consensus cadence in steps "
                "(core/trainer.py)"))

@@ -446,6 +446,13 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     # trial loop: its driver must not leak jit builds or stray host
     # syncs beyond the deliberate timing measurement it exists for
     "tune/run.py": ("autotune_step",),
+    # the MPMD pipeline tick loop (worker) and step dispatcher (driver):
+    # both run once per optimizer step; slot barriers and host-scalar
+    # conversion live cross-module in parallel/mpmd/handoff.py BY DESIGN
+    # (that module is the deliberate sync seam) — a direct sync here
+    # would double-bill the bubble measurement
+    "parallel/mpmd/stage.py": ("StageRunner.run_step",),
+    "parallel/mpmd/driver.py": ("PipelineRunner._run_step",),
 }
 
 # modules whose code runs inside dispatched workers: typed exceptions
@@ -456,6 +463,7 @@ DEFAULT_WORKER_MODULES: Tuple[str, ...] = (
     "runtime/object_store.py", "runtime/preemption.py", "runtime/queue.py",
     "runtime/session.py", "runtime/watchdog.py", "core/trainer.py",
     "testing/chaos.py", "testing/spmd_sanitizer.py",
+    "parallel/mpmd/stage.py", "parallel/mpmd/handoff.py",
 )
 
 

@@ -90,6 +90,38 @@ class TpuModule:
         """Host-side hook after each validation pass (not traced)."""
         pass
 
+    # ------------------------------------------------------------------ #
+    # MPMD pipeline hooks (parallel/mpmd): override all three to run     #
+    # with Trainer(pipeline_stages=S).  The PipelineRunner refuses a     #
+    # module missing any of them with a typed PipelineConfigError.       #
+    # ------------------------------------------------------------------ #
+    def pipeline_stage_params(self, params: Any, stage: int,
+                              num_stages: int) -> Any:
+        """Carve the full parameter tree into the subtree stage
+        ``stage`` owns (each stage group holds ONLY its slice).  Raise
+        (e.g. for an indivisible layer count) to refuse — the driver
+        wraps it into a typed config refusal."""
+        raise NotImplementedError(
+            f"{type(self).__name__}.pipeline_stage_params is required "
+            "for Trainer(pipeline_stages=...)")
+
+    def pipeline_stage_forward(self, stage_params: Any, x: Any,
+                               stage: int, num_stages: int) -> Any:
+        """One stage's forward: jax-traceable ``stage_params, x -> y``.
+        Stage 0 receives the microbatch (as yielded by the dataloader)
+        and extracts its own inputs; later stages receive the upstream
+        activation."""
+        raise NotImplementedError(
+            f"{type(self).__name__}.pipeline_stage_forward is required "
+            "for Trainer(pipeline_stages=...)")
+
+    def pipeline_loss(self, y: Any, batch: Any) -> StepOutput:
+        """Last stage only: loss (or ``(loss, metrics)``) from the final
+        activation and the microbatch (labels).  Jax-traceable."""
+        raise NotImplementedError(
+            f"{type(self).__name__}.pipeline_loss is required for "
+            "Trainer(pipeline_stages=...)")
+
     # Optional hooks mirroring PTL's checkpoint hooks (the reference's
     # BoringModel persists a counter through these,
     # reference: ray_lightning/tests/utils.py:87-91).

@@ -92,6 +92,9 @@ class Trainer:
                  shard_optimizer_state: bool = False,
                  gather_mode: str = "tree",
                  int8_matmul: bool = False,
+                 pipeline_stages: int = 1,
+                 pipeline_schedule: str = "1f1b",
+                 pipeline_microbatches: int = 4,
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -225,6 +228,51 @@ class Trainer:
         # ops/quant.py kernels where shapes allow, int8-rounded XLA dots
         # otherwise, straight-through gradients either way)
         self.int8_matmul = int8_matmul
+        # MPMD pipeline parallelism (parallel/mpmd/): pipeline_stages > 1
+        # routes fit() to a PipelineRunner over the actor runtime — S
+        # stage groups of separate processes, a 1F1B/GPipe microbatch
+        # schedule with object-store activation handoff, per-stage fault
+        # domains and checkpoint replay.  Orthogonal to the SPMD
+        # `pipeline` mesh axis (one program, layer-stacked params); see
+        # docs/API.md "Pipeline parallelism (MPMD)".
+        if not isinstance(pipeline_stages, int) or pipeline_stages < 1:
+            raise ValueError(
+                f"pipeline_stages must be an int >= 1, got "
+                f"{pipeline_stages!r}")
+        self.pipeline_stages = pipeline_stages
+        self.pipeline_schedule = pipeline_schedule
+        self.pipeline_microbatches = pipeline_microbatches
+        if pipeline_stages > 1:
+            from ..parallel.mpmd import schedule as mpmd_schedule_lib
+            if pipeline_schedule not in mpmd_schedule_lib.SCHEDULES:
+                raise ValueError(
+                    f"pipeline_schedule must be one of "
+                    f"{mpmd_schedule_lib.SCHEDULES}, got "
+                    f"{pipeline_schedule!r}")
+            if not isinstance(pipeline_microbatches, int) or \
+                    pipeline_microbatches < 1:
+                raise ValueError(
+                    f"pipeline_microbatches must be an int >= 1, got "
+                    f"{pipeline_microbatches!r}")
+            if grad_compression is not None:
+                raise ValueError(
+                    "grad_compression composes with the compiled SPMD "
+                    "gradient exchange, not with pipeline_stages > 1: "
+                    "MPMD lane gradients cross the object store in fp32 "
+                    "by design (exact parity with the single-group "
+                    "baseline)")
+            if shard_optimizer_state:
+                raise ValueError(
+                    "shard_optimizer_state=True (ZeRO-1) is an SPMD-mesh "
+                    "feature; under pipeline_stages > 1 each stage group "
+                    "shards within its stage instead — pass fsdp>1 "
+                    "through the pipeline runner")
+            if accumulate_grad_batches > 1:
+                raise ValueError(
+                    "accumulate_grad_batches > 1 is redundant under "
+                    "pipeline_stages > 1: the pipeline schedule already "
+                    "accumulates pipeline_microbatches gradients per "
+                    "optimizer step")
         # analytic bytes-on-wire record for the compiled gradient
         # exchange (collectives.wire_bytes_per_step); also mirrored onto
         # the profiler when one is attached
@@ -1860,6 +1908,9 @@ class Trainer:
             # launch-plan resolution must be reported under THIS run's
             # fresh trace, not the previous fit's id/telemetry
             self._bind_trace()
+            if self.pipeline_stages > 1:
+                return self._fit_mpmd(module, train_dataloaders,
+                                      datamodule, ckpt_path)
             plan = self._launch_plan()
             if plan is not None:
                 return self._fit_via_launcher(plan, module,
@@ -1985,6 +2036,65 @@ class Trainer:
             except Exception as e:
                 log.warning("cluster-view merge failed: %s", e)
         return reg
+
+    def _fit_mpmd(self, module: TpuModule, train_dataloaders=None,
+                  datamodule=None, ckpt_path: Optional[str] = None) -> None:
+        """MPMD pipeline fit: the training loop is owned by a
+        ``parallel/mpmd`` :class:`PipelineRunner` — S stage groups of
+        worker processes running the 1F1B/GPipe tick program, microbatch
+        activations crossing stages through the shared-memory object
+        store, failures attributed to (and replayed within) the faulting
+        stage's budget.  The trainer contributes batch collection, the
+        run trace, and surfaces the runner's summary (losses, measured
+        vs analytic bubble, per-stage budgets) through
+        ``self.pipeline_summary`` / ``callback_metrics``."""
+        from ..parallel.mpmd.driver import PipelineRunner
+        if ckpt_path is not None:
+            raise ValueError(
+                "ckpt_path is not supported with pipeline_stages > 1: the "
+                "pipeline runner manages its own per-stage checkpoints "
+                "(and replay) under default_root_dir")
+        if datamodule is not None:
+            datamodule.setup("fit")
+            train_dataloaders = (train_dataloaders
+                                 or datamodule.train_dataloader())
+        if train_dataloaders is None:
+            raise ValueError("fit() needs train_dataloaders or a datamodule")
+        self.fitting = True
+        self.module = module
+        module.trainer = self
+        # one pass per epoch over the loader, bounded exactly like the
+        # local loop: limit_train_batches per epoch, max_steps overall
+        batches: List[Any] = []
+        for _ in range(self.max_epochs or 1):
+            for i, batch in enumerate(train_dataloaders):
+                if (self.limit_train_batches is not None
+                        and i >= self.limit_train_batches):
+                    break
+                batches.append(batch)
+                if (self.max_steps is not None
+                        and len(batches) >= self.max_steps):
+                    break
+            if self.max_steps is not None and len(batches) >= self.max_steps:
+                break
+        runner = PipelineRunner(
+            module, num_stages=self.pipeline_stages,
+            num_workers=getattr(self.accelerator, "num_workers", None),
+            schedule=self.pipeline_schedule,
+            num_microbatches=self.pipeline_microbatches,
+            seed=self.seed, workdir=self.default_root_dir,
+            wedge_timeout_s=self.worker_deadline_s)
+        try:
+            summary = runner.run(batches)
+        finally:
+            runner.shutdown()
+        self.pipeline_summary = summary
+        self.trace_id = summary["trace_id"]
+        self.global_step = len(summary["steps"])
+        if summary["losses"]:
+            self.callback_metrics["train_loss"] = float(
+                summary["losses"][-1])
+        self.fitting = False
 
     def _fit_local(self, module: TpuModule,
                    train_dataloaders=None, val_dataloaders=None,

@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import tempfile
 import threading
 from typing import Iterator, Optional, Sequence, Tuple
@@ -49,6 +50,11 @@ def _compile(out: str) -> None:
     tmp = f"{out}.tmp.{os.getpid()}"  # unique per process: concurrent-safe
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            "-o", tmp] + srcs
+    if sys.platform.startswith("linux"):
+        # shm_open/shm_unlink live in librt until glibc 2.34 (a no-op
+        # stub after); without this the .so loads but shm symbols are
+        # unresolved and the object store reports itself unavailable
+        cmd.append("-lrt")
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")

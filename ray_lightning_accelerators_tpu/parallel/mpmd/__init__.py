@@ -1,0 +1,43 @@
+"""MPMD pipeline parallelism over the actor runtime.
+
+"Scaling Deep Learning Training with MPMD Pipeline Parallelism"
+(PAPERS.md) applied to this repo's runtime: instead of ONE shard_map
+program spanning a ``pipeline`` mesh axis (``parallel/pipeline.py``),
+training runs as **multiple actor groups, one SPMD program per stage** —
+each stage group compiles its own forward/backward against its own
+(within-stage) ShardingPlan, and microbatch activations/activation-grads
+move between neighbor stages through ``runtime/object_store.py`` shm
+refs instead of ``ppermute`` hops.
+
+Modules:
+
+- :mod:`.schedule` — the deterministic per-stage tick programs (1F1B,
+  with GPipe as the degenerate all-warmup case) plus the cross-stage
+  handoff audit (the PR 12 sequence-diff analog for slot programs);
+- :mod:`.handoff` — the transport plane: the filesystem mailbox that
+  carries ObjectRefs between stage processes, the typed
+  :class:`~.handoff.PipelineHandoffTimeout`, and the deliberate
+  slot-barrier timing helpers the hot tick loops call cross-module;
+- :mod:`.stage` — the worker-side :class:`~.stage.StageRunner`: one
+  stage's jitted fwd/bwd/opt programs (FSDP within the stage via the
+  ``parallel/plan.py`` leaf authors) executing its tick program;
+- :mod:`.driver` — the driver-side :class:`~.driver.PipelineRunner`:
+  carves an ``ActorPool`` into S stage groups, threads one trace id
+  across every stage's tick events, prices the bubble through the
+  StepTimeline, and replays from checkpoint on a lost/wedged stage
+  group with per-stage failure budgets.
+"""
+
+from .driver import (PipelineConfigError, PipelineRunner,
+                     PipelineStageFailed)
+from .handoff import PipelineHandoffTimeout
+from .schedule import (SCHEDULES, PipelineScheduleError,
+                       analytic_bubble_fraction, audit_programs,
+                       build_programs, program_fingerprint, stage_program)
+
+__all__ = [
+    "PipelineConfigError", "PipelineRunner", "PipelineStageFailed",
+    "PipelineHandoffTimeout", "PipelineScheduleError", "SCHEDULES",
+    "analytic_bubble_fraction", "audit_programs", "build_programs",
+    "program_fingerprint", "stage_program",
+]

@@ -1,0 +1,202 @@
+"""Cross-stage transport: mailbox for ObjectRefs + slot barrier helpers.
+
+The payloads themselves (activations, activation-grads) never touch this
+module — they live in ``runtime/object_store.py`` shm segments, exactly
+one host copy each.  What moves between stage processes here is the
+small picklable :class:`~..runtime.object_store.ObjectRef` handle,
+through a filesystem mailbox: one file per (step, kind, edge,
+microbatch, lane), written atomically (tmp + ``os.replace``) so a
+reader never sees a torn handle.  This is the MPMD analog of the SPMD
+pipeline's ``ppermute`` edge — same dataflow graph, but the edge is
+now preemptible, timeout-guarded, and attributable to a stage.
+
+This module is deliberately **not** a graftlint hot root: the blocking
+waits, ``jax.block_until_ready`` slot barriers, and device→host scalar
+conversions that the ``host-sync`` rule bans from the tick loops all
+live here and are called cross-module.  That is the design, not an
+evasion — a slot barrier is the *semantics* of a schedule slot (a tick
+is not done until its compute is), and pricing it anywhere else would
+misattribute bubble time to the next slot's recv.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from ...analysis import knobs
+from ...runtime.object_store import ObjectRef
+
+#: activations flow down this kind, activation-grads flow back up, and
+#: lane-peer grad exchange (stage groups wider than one worker) uses a
+#: third kind so the edge namespace never collides.
+KIND_ACT = "act"
+KIND_GRAD = "grad"
+KIND_LANE_GRAD = "lgrad"
+
+DEFAULT_TIMEOUT_S = 60.0
+_POLL_S = 0.002
+
+
+class PipelineHandoffTimeout(RuntimeError):
+    """A stage waited past its deadline for a neighbor's handoff.
+
+    Carries a machine-readable diagnosis embedded in the message (the
+    ``WorkerWedged``/``CollectiveMismatch`` marker idiom) so it survives
+    the actor pipe as ``(type, message)`` and the driver can still name
+    the *other* stage as the suspect: a timeout is evidence about the
+    sender, not the waiter.
+    """
+
+    _MARKER = "| handoff="
+
+    def __init__(self, message: str,
+                 diagnosis: Optional[dict] = None):
+        super().__init__(message)
+        self.diagnosis = diagnosis or {}
+
+    @classmethod
+    def for_wait(cls, *, stage: int, kind: str, src: int, microbatch: int,
+                 lane: int, step: int,
+                 timeout_s: float) -> "PipelineHandoffTimeout":
+        diagnosis = {"stage": stage, "kind": kind, "src": src,
+                     "microbatch": microbatch, "lane": lane, "step": step,
+                     "timeout_s": timeout_s}
+        return cls(
+            f"stage {stage} timed out after {timeout_s:.1f}s waiting for "
+            f"{kind} of microbatch {microbatch} (lane {lane}) from stage "
+            f"{src} at step {step} {cls._MARKER}{json.dumps(diagnosis)}",
+            diagnosis)
+
+    @classmethod
+    def from_message(cls, message: str) -> "PipelineHandoffTimeout":
+        """Rebuild driver-side from the wire message, diagnosis intact
+        (registered in ``runtime/wire.py``)."""
+        diagnosis: Optional[dict] = None
+        if cls._MARKER in message:
+            try:
+                diagnosis = json.loads(
+                    message.rsplit(cls._MARKER, 1)[1].strip())
+            except (ValueError, IndexError):
+                diagnosis = None
+        return cls(message, diagnosis)
+
+
+class Mailbox:
+    """Atomic single-file-per-handoff ref exchange under one directory.
+
+    All stage processes of one PipelineRunner share ``root`` (driver
+    tempdir).  File names carry the full edge identity::
+
+        s{step:06d}.{kind}.{src}to{dst}.mb{mb}.l{lane}.ref
+
+    so a late reader can never match a stale step's handoff, and a
+    postmortem ``ls`` of the mailbox *is* the in-flight edge set.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, step: int, kind: str, src: int, dst: int,
+              microbatch: int, lane: int) -> str:
+        return os.path.join(
+            self.root,
+            f"s{step:06d}.{kind}.{src}to{dst}.mb{microbatch}.l{lane}.ref")
+
+    # ------------------------------------------------------------------ #
+    def send(self, ref: ObjectRef, *, step: int, kind: str, src: int,
+             dst: int, microbatch: int, lane: int = 0) -> None:
+        path = self._path(step, kind, src, dst, microbatch, lane)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(ref, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def recv(self, *, step: int, kind: str, src: int, dst: int,
+             microbatch: int, lane: int = 0,
+             timeout_s: Optional[float] = None) -> ObjectRef:
+        """Block until the handoff file lands; typed timeout past the
+        deadline (default from ``RLA_TPU_PIPELINE_HANDOFF_TIMEOUT_S``)."""
+        if timeout_s is None:
+            timeout_s = knobs.get_float("RLA_TPU_PIPELINE_HANDOFF_TIMEOUT_S",
+                                        DEFAULT_TIMEOUT_S)
+        path = self._path(step, kind, src, dst, microbatch, lane)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    return pickle.load(f)
+            except FileNotFoundError:
+                pass
+            except (EOFError, pickle.UnpicklingError):
+                pass  # torn write cannot happen (os.replace), but a
+                #      crashed writer's .tmp never matches this path
+            if time.monotonic() >= deadline:
+                raise PipelineHandoffTimeout.for_wait(
+                    stage=dst, kind=kind, src=src, microbatch=microbatch,
+                    lane=lane, step=step, timeout_s=timeout_s)
+            time.sleep(_POLL_S)
+
+    def clear(self) -> int:
+        """Drop every pending handoff (replay boundary: stale refs from
+        the failed epoch must not satisfy the re-run's recvs)."""
+        dropped = 0
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return 0
+        for entry in entries:
+            if entry.endswith(".ref") or ".ref.tmp." in entry:
+                try:
+                    os.unlink(os.path.join(self.root, entry))
+                    dropped += 1
+                except FileNotFoundError:
+                    pass
+        return dropped
+
+
+# --------------------------------------------------------------------- #
+# Slot barrier + host-conversion helpers (called cross-module from the  #
+# hot tick loops — see module docstring for why they live here)         #
+# --------------------------------------------------------------------- #
+def timed_call(fn: Callable[..., Any], *args: Any) -> Tuple[Any, float]:
+    """Run one compute slot to completion and price it: returns
+    ``(result, seconds)`` with the result blocked-until-ready so the
+    wall time is the slot's true device time, not dispatch latency."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    out = jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def host_scalars(tree: Any) -> Any:
+    """Device scalars → python floats for the step summary that crosses
+    the actor pipe (one transfer, after the tick program finishes)."""
+    import jax
+
+    return jax.tree_util.tree_map(float, jax.device_get(tree))
+
+
+def split_microbatches(batch: Any, num_microbatches: int) -> List[Any]:
+    """Split every leaf of a batch along axis 0 into M equal
+    microbatches.  The caller (driver) has already validated
+    divisibility with a typed refusal."""
+    import jax
+    import numpy as np
+
+    def _split(leaf: Any) -> List[Any]:
+        return np.split(np.asarray(leaf), num_microbatches, axis=0)
+
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    split_leaves = [_split(leaf) for leaf in leaves]
+    return [jax.tree_util.tree_unflatten(
+        treedef, [parts[m] for parts in split_leaves])
+        for m in range(num_microbatches)]

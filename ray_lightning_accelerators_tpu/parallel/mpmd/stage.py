@@ -1,0 +1,360 @@
+"""StageRunner: one pipeline stage as its own SPMD program.
+
+Worker-process side of the MPMD pipeline.  Each stage group member
+builds exactly one of these at init dispatch and then executes its
+deterministic tick program (``schedule.stage_program``) once per
+optimizer step.  The MPMD inversion relative to
+``parallel/pipeline.py``: there, one jitted program spans the
+``pipeline`` mesh axis and XLA inserts ``ppermute`` edges; here each
+stage compiles a **fixed, small set of programs against its own local
+mesh** — forward, backward, optimizer-apply — and the cross-stage edges
+are object-store refs through the ``handoff.Mailbox``.  Within a stage,
+parallelism is plain SPMD again: params are placed by the
+``parallel/plan.py`` FSDP leaf author over a local ``fsdp`` mesh axis,
+so "FSDP inside, pipeline outside" composes without any new sharding
+machinery.
+
+Program-count contract (pinned by ``compile_guard`` in tests): a
+non-last stage owns 3 jitted programs (fwd, bwd, apply), the last stage
+2 (fused loss+grad, apply) — all constructed once in ``__init__``
+(graftlint ``retrace`` rule), so steady state is zero recompiles.
+
+Backward recomputes the stage forward under ``jax.vjp`` per microbatch
+(remat-style) instead of checkpointing residuals across slots: the only
+cross-slot state is the raw activation input, which 1F1B already bounds
+at ``min(S - stage, M)`` live microbatches.
+
+The tick loop (``run_step``) is a graftlint hot root: every blocking
+wait, slot barrier and device→host conversion it needs lives
+cross-module in ``handoff``/``runtime.object_store`` by design (see
+``handoff``'s module docstring), and the step summary converts to host
+scalars once, in the ``mpmd_stage_step`` dispatch wrapper.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ...analysis import compile_guard
+from ...runtime import object_store
+from ...runtime.object_store import ObjectRef
+from ...telemetry import recorder
+from .. import mesh as mesh_lib
+from .. import plan as plan_lib
+from . import handoff
+from .handoff import KIND_ACT, KIND_GRAD, KIND_LANE_GRAD, Mailbox
+from .schedule import (OP_BWD, OP_FWD, OP_OPT, OP_RECV_ACT, OP_RECV_GRAD,
+                       OP_SEND_ACT, OP_SEND_GRAD, program_fingerprint,
+                       stage_program)
+
+# the one StageRunner of this worker process (built by mpmd_stage_init,
+# the dispatch functions below close over nothing — cloudpickle ships
+# them by reference and they find the runner here)
+_RUNNER: Optional["StageRunner"] = None
+
+
+class StageRunner:
+    """One stage group member: local mesh, jitted programs, tick loop."""
+
+    def __init__(self, module: Any, *, stage: int, num_stages: int,
+                 lane: int = 0, num_lanes: int = 1,
+                 schedule: str = "1f1b", microbatches_per_lane: int = 1,
+                 mailbox_root: str, fsdp: int = 1,
+                 stage_params: Any, opt_state: Any = None):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        compile_guard.install()
+        if num_stages < 2:
+            raise ValueError("StageRunner needs num_stages >= 2 — a "
+                             "1-stage pipeline is the plain Trainer path")
+        self.stage = stage
+        self.num_stages = num_stages
+        self.lane = lane
+        self.num_lanes = num_lanes
+        self.schedule = schedule
+        self.m_lane = microbatches_per_lane
+        self.is_first = stage == 0
+        self.is_last = stage == num_stages - 1
+        self.program = stage_program(schedule, stage, num_stages,
+                                     microbatches_per_lane)
+        self.mailbox = Mailbox(mailbox_root)
+        self._store = object_store.global_store()
+        self._sent_refs: List[ObjectRef] = []
+        self._recv_refs: List[ObjectRef] = []
+
+        # ---- local mesh + within-stage FSDP placement ---------------- #
+        fsdp = max(1, fsdp)
+        devices = jax.devices()[:fsdp]
+        self.mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=1, fsdp=fsdp), devices=devices)
+
+        def _place(leaf):
+            spec = plan_lib.fsdp_leaf_spec(self.mesh, leaf)
+            if spec is None:  # wants sharding, nothing divides: replicate
+                spec = plan_lib.replicated_spec()
+            return jax.device_put(
+                jnp.asarray(leaf),
+                jax.sharding.NamedSharding(self.mesh, spec))
+
+        self.params = jax.tree_util.tree_map(_place, stage_params)
+        self._tx = module.configure_optimizers()
+        template = self._tx.init(self.params)
+        if opt_state is None:
+            self.opt_state = template
+        else:
+            # restore checkpointed moments onto the template's placement
+            self.opt_state = jax.tree_util.tree_map(
+                lambda t, h: jax.device_put(jnp.asarray(h), t.sharding)
+                if hasattr(t, "sharding") else h, template, opt_state)
+        self._acc = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+
+        # ---- the fixed program set (constructed ONCE, here) ---------- #
+        s, n = stage, num_stages
+        inv_m = 1.0 / (num_lanes * microbatches_per_lane)
+
+        def _forward(p, x):
+            return module.pipeline_stage_forward(p, x, s, n)
+
+        if not self.is_last:
+            def _bwd_fn(p, acc, x, gy):
+                _, vjp = jax.vjp(_forward, p, x)
+                gp, gx = vjp(gy)
+                return gx, jax.tree_util.tree_map(jnp.add, acc, gp)
+
+            self._fwd = jax.jit(_forward)
+            self._bwd = jax.jit(_bwd_fn)
+        else:
+            def _last_fn(p, acc, x, batch):
+                def loss_fn(pp, xx):
+                    y = module.pipeline_stage_forward(pp, xx, s, n)
+                    out = module.pipeline_loss(y, batch)
+                    if isinstance(out, tuple):
+                        return out[0], out[1]
+                    return out, {}
+                (loss, metrics), (gp, gx) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(p, x)
+                return (loss, metrics, gx,
+                        jax.tree_util.tree_map(jnp.add, acc, gp))
+
+            self._last = jax.jit(_last_fn)
+
+        def _apply_fn(p, opt, acc):
+            grads = jax.tree_util.tree_map(lambda g: g * inv_m, acc)
+            updates, new_opt = self._tx.update(grads, opt, p)
+            new_p = optax.apply_updates(p, updates)
+            return new_p, new_opt, jax.tree_util.tree_map(
+                jnp.zeros_like, acc)
+
+        self._apply = jax.jit(_apply_fn)
+
+    # ------------------------------------------------------------------ #
+    def _member(self, stage: int, lane: int) -> int:
+        """Global member index — the lane-grad edge namespace (stage
+        pairs alone would collide across stages in one mailbox)."""
+        return stage * self.num_lanes + lane
+
+    def release_step_resources(self) -> None:
+        """Drop the PREVIOUS step's transport state: shm segments this
+        member published (consumed — the driver barriers every step) and
+        zero-copy mappings it held on neighbors' segments."""
+        for ref in self._sent_refs:
+            self._store.delete(ref)
+        self._sent_refs = []
+        for ref in self._recv_refs:
+            self._store.release(ref)
+        self._recv_refs = []
+
+    # ------------------------------------------------------------------ #
+    def run_step(self, step: int,
+                 input_refs: Optional[List[ObjectRef]]) -> Dict[str, Any]:
+        """Execute this stage's tick program for one optimizer step.
+
+        Graftlint hot root: all host syncs are cross-module by design
+        (``handoff.timed_call`` is the deliberate slot barrier).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        self.release_step_resources()
+        t_start = time.perf_counter()
+        mb = self.mailbox
+        xs: Dict[int, Any] = {}       # microbatch -> forward input
+        ys: Dict[int, Any] = {}       # microbatch -> activation out
+        gys: Dict[int, Any] = {}      # microbatch -> grad from downstream
+        gxs: Dict[int, Any] = {}      # microbatch -> grad for upstream
+        batches: Dict[int, Any] = {}  # last stage: loss batches
+        acc = self._acc
+        loss_sum = None
+        metrics_sum = None
+        busy_s = 0.0
+        ticks: List[Any] = []
+
+        def gmb(m: int) -> int:
+            return self.lane * self.m_lane + m
+
+        for op, m in self.program:
+            t0 = time.perf_counter()
+            if op == OP_RECV_ACT:
+                ref = mb.recv(step=step, kind=KIND_ACT, src=self.stage - 1,
+                              dst=self.stage, microbatch=gmb(m),
+                              lane=self.lane)
+                self._recv_refs.append(ref)
+                xs[m] = self._store.get(ref, copy=False)
+                dt = time.perf_counter() - t0
+            elif op == OP_FWD:
+                if self.is_first:
+                    self._recv_refs.append(input_refs[m])
+                    xs[m] = self._store.get(input_refs[m], copy=False)
+                if self.is_last:
+                    # loss batch rides the same driver refs as stage-0
+                    # input; compute is fused into the OP_BWD slot
+                    self._recv_refs.append(input_refs[m])
+                    batches[m] = self._store.get(input_refs[m], copy=False)
+                    dt = time.perf_counter() - t0
+                else:
+                    ys[m], dt = handoff.timed_call(
+                        self._fwd, self.params, xs[m])
+                    busy_s += dt
+            elif op == OP_SEND_ACT:
+                ref = self._store.put(ys.pop(m))
+                self._sent_refs.append(ref)
+                mb.send(ref, step=step, kind=KIND_ACT, src=self.stage,
+                        dst=self.stage + 1, microbatch=gmb(m),
+                        lane=self.lane)
+                dt = time.perf_counter() - t0
+            elif op == OP_RECV_GRAD:
+                ref = mb.recv(step=step, kind=KIND_GRAD,
+                              src=self.stage + 1, dst=self.stage,
+                              microbatch=gmb(m), lane=self.lane)
+                self._recv_refs.append(ref)
+                gys[m] = self._store.get(ref, copy=False)
+                dt = time.perf_counter() - t0
+            elif op == OP_BWD:
+                if self.is_last:
+                    out, dt = handoff.timed_call(
+                        self._last, self.params, acc, xs.pop(m),
+                        batches.pop(m))
+                    loss, metrics, gx, acc = out
+                    loss_sum = loss if loss_sum is None else loss_sum + loss
+                    if metrics_sum is None:
+                        metrics_sum = metrics
+                    else:
+                        metrics_sum = jax.tree_util.tree_map(
+                            jnp.add, metrics_sum, metrics)
+                else:
+                    out, dt = handoff.timed_call(
+                        self._bwd, self.params, acc, xs.pop(m), gys.pop(m))
+                    gx, acc = out
+                busy_s += dt
+                gxs[m] = gx
+            elif op == OP_SEND_GRAD:
+                ref = self._store.put(gxs.pop(m))
+                self._sent_refs.append(ref)
+                mb.send(ref, step=step, kind=KIND_GRAD, src=self.stage,
+                        dst=self.stage - 1, microbatch=gmb(m),
+                        lane=self.lane)
+                dt = time.perf_counter() - t0
+            else:  # OP_OPT
+                if self.num_lanes > 1:
+                    acc = self._lane_grad_exchange(step, acc)
+                out, dt = handoff.timed_call(
+                    self._apply, self.params, self.opt_state, acc)
+                self.params, self.opt_state, acc = out
+                busy_s += dt
+            ticks.append((op, m, dt))
+            recorder.emit("pipeline_tick", step=step, stage=self.stage,
+                          lane=self.lane, op=op, microbatch=m, dt_s=dt)
+        self._acc = acc
+        wall_s = time.perf_counter() - t_start
+        if loss_sum is not None:
+            loss_sum = loss_sum / self.m_lane
+        if metrics_sum is not None:
+            metrics_sum = jax.tree_util.tree_map(
+                lambda v: v / self.m_lane, metrics_sum)
+        return {"loss": loss_sum, "metrics": metrics_sum,
+                "busy_s": busy_s, "wall_s": wall_s, "ticks": ticks}
+
+    # ------------------------------------------------------------------ #
+    def _lane_grad_exchange(self, step: int, acc: Any) -> Any:
+        """Sum grad accumulators across the stage group's lanes (data-
+        parallel pipelines of the same stage), in lane-index order so
+        every lane reduces in the SAME order and applies an identical
+        update — the mailbox analog of a deterministic psum."""
+        import jax
+        import jax.numpy as jnp
+
+        me = self._member(self.stage, self.lane)
+        ref = self._store.put(acc)
+        self._sent_refs.append(ref)
+        for peer in range(self.num_lanes):
+            if peer == self.lane:
+                continue
+            mb_lane = peer  # receiver-keyed so each peer polls its own file
+            self.mailbox.send(ref, step=step, kind=KIND_LANE_GRAD,
+                              src=me, dst=self._member(self.stage, peer),
+                              microbatch=0, lane=mb_lane)
+        parts: Dict[int, Any] = {self.lane: acc}
+        for peer in range(self.num_lanes):
+            if peer == self.lane:
+                continue
+            pref = self.mailbox.recv(
+                step=step, kind=KIND_LANE_GRAD,
+                src=self._member(self.stage, peer), dst=me,
+                microbatch=0, lane=self.lane)
+            self._recv_refs.append(pref)
+            parts[peer] = self._store.get(pref, copy=False)
+        total = parts[0]
+        for peer in range(1, self.num_lanes):
+            total = jax.tree_util.tree_map(jnp.add, total, parts[peer])
+        return total
+
+
+# --------------------------------------------------------------------- #
+# Dispatch surface (cloudpickled to workers by the PipelineRunner)      #
+# --------------------------------------------------------------------- #
+def mpmd_stage_init(stage_params: Any, opt_state: Any,
+                    spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Build this process's StageRunner.  ``stage_params``/``opt_state``
+    arrive as top-level ObjectRefs and are derefed by the actor layer
+    (Ray-style call-site deref)."""
+    global _RUNNER
+    _RUNNER = StageRunner(
+        spec["module"], stage=spec["stage"],
+        num_stages=spec["num_stages"], lane=spec["lane"],
+        num_lanes=spec["num_lanes"], schedule=spec["schedule"],
+        microbatches_per_lane=spec["microbatches_per_lane"],
+        mailbox_root=spec["mailbox_root"], fsdp=spec.get("fsdp", 1),
+        stage_params=stage_params, opt_state=opt_state)
+    return {"stage": _RUNNER.stage, "lane": _RUNNER.lane,
+            "fingerprint": program_fingerprint(_RUNNER.program),
+            "slots": len(_RUNNER.program),
+            "compiles": compile_guard.compile_count()}
+
+
+def mpmd_stage_step(step: int,
+                    input_refs: Optional[List[ObjectRef]]
+                    ) -> Dict[str, Any]:
+    """One optimizer step of this member's tick program; the summary
+    crosses the pipe as host scalars (one conversion, here — never in
+    the tick loop)."""
+    out = _RUNNER.run_step(step, input_refs)
+    host = handoff.host_scalars(
+        {"loss": out["loss"], "metrics": out["metrics"]})
+    return {"stage": _RUNNER.stage, "lane": _RUNNER.lane, "step": step,
+            "loss": host["loss"], "metrics": host["metrics"],
+            "busy_s": out["busy_s"], "wall_s": out["wall_s"],
+            "ticks": out["ticks"],
+            "compiles": compile_guard.compile_count()}
+
+
+def mpmd_stage_state() -> Dict[str, Any]:
+    """This member's checkpointable state, as host arrays (gathered by
+    the driver into the per-stage checkpoint extra)."""
+    import jax
+
+    return {"stage": _RUNNER.stage, "lane": _RUNNER.lane,
+            "params": jax.device_get(_RUNNER.params),
+            "opt_state": jax.device_get(_RUNNER.opt_state)}

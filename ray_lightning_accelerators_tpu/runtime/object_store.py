@@ -78,13 +78,33 @@ class ObjectStore:
         self.threshold = threshold
         self._lock = threading.Lock()
         self._owned: Dict[str, List[str]] = {}  # object_id -> segment names
-        self._mappings: List[Tuple[int, int]] = []  # zero-copy (ptr, nbytes)
+        self._owned_bytes: Dict[str, int] = {}  # object_id -> shm bytes
+        # zero-copy mappings, keyed by object_id so release(ref) can drop
+        # exactly one object's views (pipeline handoff: a stage unmaps the
+        # previous step's received activations at the next step boundary)
+        self._mappings: Dict[str, List[Tuple[int, int]]] = {}
         self._prefix = f"/rla-{os.getpid()}-{secrets.token_hex(4)}"
         self._counter = 0
         atexit.register(self.shutdown)
 
+    def total_shm_bytes(self) -> int:
+        """Live shm bytes this store OWNS (placed and not yet deleted) —
+        the ``object_store_shm`` gauge the perf HBM/host ledger samples."""
+        with self._lock:
+            return sum(self._owned_bytes.values())
+
     # ------------------------------------------------------------------ #
     def put(self, obj: Any) -> ObjectRef:
+        """Store a pytree; large array leaves ride shared memory.
+
+        Copy discipline (the pipeline-handoff fast path): exactly ONE
+        copy per large leaf — ``np.copyto`` into the mapped segment.
+        ``np.asarray`` on a CPU-backend ``jax.Array`` and
+        ``np.ascontiguousarray`` on an already-contiguous array are both
+        zero-copy views, so a stage publishing activations pays one
+        host-side memcpy, and the receiver's ``get(copy=False)`` pays
+        none (it feeds the read-only mapping straight to its programs).
+        """
         import jax
 
         lib = native.lib()
@@ -101,12 +121,12 @@ class ObjectStore:
                 if isinstance(leaf, np.ndarray):
                     arr = leaf
                 elif isinstance(leaf, jax.Array):
-                    arr = np.asarray(leaf)  # device -> host once, here
+                    arr = np.asarray(leaf)  # view on CPU; one copy off-host
                 if (arr is None or arr.dtype.hasobject
                         or arr.nbytes < self.threshold):
                     out_leaves.append(arr if arr is not None else leaf)
                     continue
-                arr = np.ascontiguousarray(arr)
+                arr = np.ascontiguousarray(arr)  # no-op when contiguous
                 name = f"{object_id}-{len(segments)}"
                 ptr = lib.rla_shm_create(name.encode(), arr.nbytes)
                 if not ptr:
@@ -126,9 +146,11 @@ class ObjectStore:
             raise
         payload = cloudpickle.dumps(
             jax.tree_util.tree_unflatten(treedef, out_leaves))
+        ref = ObjectRef(object_id, tuple(segments), payload)
         with self._lock:
             self._owned[object_id] = names
-        return ObjectRef(object_id, tuple(segments), payload)
+            self._owned_bytes[object_id] = ref.total_shm_bytes()
+        return ref
 
     # ------------------------------------------------------------------ #
     def get(self, ref: ObjectRef, copy: bool = True) -> Any:
@@ -158,7 +180,8 @@ class ObjectStore:
                 lib.rla_shm_unmap(ptr, nbytes)
             else:
                 with self._lock:
-                    self._mappings.append((ptr, nbytes))
+                    self._mappings.setdefault(
+                        ref.object_id, []).append((ptr, nbytes))
                 arrays.append(view)
         tree = cloudpickle.loads(ref.payload)
         return jax.tree_util.tree_map(
@@ -166,10 +189,22 @@ class ObjectStore:
             tree, is_leaf=lambda l: isinstance(l, _Placeholder))
 
     # ------------------------------------------------------------------ #
+    def release(self, ref: ObjectRef) -> None:
+        """Unmap the zero-copy views a ``get(copy=False)`` of this ref
+        retained.  Caller contract: every array that aliased the mapping
+        is dead by now (the pipeline tick loop releases a step's refs at
+        the NEXT step boundary, after its programs consumed them)."""
+        lib = native.lib()
+        with self._lock:
+            mappings = self._mappings.pop(ref.object_id, [])
+        for ptr, nbytes in mappings:
+            lib.rla_shm_unmap(ptr, nbytes)
+
     def delete(self, ref: ObjectRef) -> None:
         lib = native.lib()
         with self._lock:
             names = self._owned.pop(ref.object_id, None)
+            self._owned_bytes.pop(ref.object_id, None)
         for name in (names if names is not None
                      else [s[0] for s in ref.segments]):
             lib.rla_shm_unlink(name.encode())
@@ -182,9 +217,11 @@ class ObjectStore:
         with self._lock:
             owned = list(self._owned.values())
             self._owned.clear()
-            mappings, self._mappings = self._mappings, []
-        for ptr, nbytes in mappings:
-            lib.rla_shm_unmap(ptr, nbytes)
+            self._owned_bytes.clear()
+            mappings, self._mappings = self._mappings, {}
+        for per_obj in mappings.values():
+            for ptr, nbytes in per_obj:
+                lib.rla_shm_unmap(ptr, nbytes)
         for names in owned:
             for name in names:
                 lib.rla_shm_unlink(name.encode())
@@ -225,3 +262,12 @@ def put(obj: Any) -> ObjectRef:
 def get(ref: ObjectRef, copy: bool = True) -> Any:
     """``ray.get`` analog on the process-global store."""
     return global_store().get(ref, copy=copy)
+
+
+def global_shm_bytes() -> int:
+    """Gauge for the perf HBM/host ledger: live shm bytes owned by this
+    process's global store (0 when no store was ever built — sampling
+    must not instantiate one)."""
+    with _GLOBAL_LOCK:
+        store = _GLOBAL
+    return store.total_shm_bytes() if store is not None else 0

@@ -34,12 +34,14 @@ WIRE_EXCEPTION_NAMES = frozenset({
     "QueueShutdown",
     "ObjectStoreError",
     "CollectiveMismatch",
+    "PipelineHandoffTimeout",
 })
 
 
 def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
     # imported lazily: wire.py must stay importable from any runtime
     # module without creating cycles
+    from ..parallel.mpmd.handoff import PipelineHandoffTimeout
     from ..testing.spmd_sanitizer import CollectiveMismatch
     from .elastic import ElasticResizeError
     from .object_store import ObjectStoreError
@@ -54,6 +56,7 @@ def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
         "QueueShutdown": QueueShutdown,
         "ObjectStoreError": ObjectStoreError,
         "CollectiveMismatch": CollectiveMismatch.from_message,
+        "PipelineHandoffTimeout": PipelineHandoffTimeout.from_message,
     }
 
 

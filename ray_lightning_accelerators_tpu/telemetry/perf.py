@@ -64,6 +64,9 @@ PHASE_KINDS = frozenset({
     "h2d", "compute", "compile", "ckpt", "drain", "validation", "other",
     # serve engine loop (serve/engine.py)
     "prefill", "decode",
+    # MPMD pipeline driver (parallel/mpmd/driver.py): step wall minus
+    # mean per-member busy — the schedule's idle fraction as a phase
+    "pipeline_bubble",
 })
 
 GOODPUT_CATEGORIES = ("productive", "compile", "checkpoint", "drain",
@@ -634,6 +637,14 @@ class PerfObservatory:
         self.timeline = timeline
         self.hbm = hbm if hbm is not None else HbmLedger()
         self.goodput = goodput if goodput is not None else GoodputLedger()
+        try:
+            # host-side shm owned by this process's object store (the
+            # pipeline-handoff transport) as an attribution pool: the
+            # reader returns 0 until a store exists and never builds one
+            from ..runtime.object_store import global_shm_bytes
+            self.hbm.register_pool("object_store_shm", global_shm_bytes)
+        except Exception:
+            pass
 
     def __getstate__(self):
         return {}

@@ -97,6 +97,11 @@ EVENT_KINDS = frozenset({
     # resharding window — old/new world size, redistribution bytes
     # moved, waves and wall seconds
     "resize_begin", "resize_end",
+    # MPMD pipeline (parallel/mpmd): one slot of a stage's tick program
+    # (worker-side), one optimizer step across all stage groups
+    # (driver-side), and one checkpoint-replay recovery — all stamped
+    # with the fit's trace id so the cross-stage timeline stitches
+    "pipeline_tick", "pipeline_step", "pipeline_replay",
     # serve lifecycle (serve/engine.py)
     "serve_admit", "serve_prefill", "serve_decode_step", "serve_respond",
     # serve SLO engine (serve/slo.py): a request missed its attached

@@ -122,6 +122,10 @@ class ChaosFault:
     # loop in runtime/actors._worker_main (step = dispatch index),
     # "replica" = serve.replicas._replica_serve (step = chunk index)
     layer: str = LAYER_WORKER
+    # pipeline stage-group target ('stageN'): the fault applies to every
+    # member of that stage group — injectors constructed in a process
+    # whose RLA_TPU_PIPELINE_STAGE differs drop it at filter time
+    stage: Optional[int] = None
 
     def matches(self, rank: int, step: int) -> bool:
         if self.rank is not None and self.rank != rank:
@@ -137,7 +141,12 @@ class ChaosFault:
         prefixed for replica faults so a replica chunk claim can never
         collide with a worker dispatch claim)."""
         prefix = "replica" if self.layer == LAYER_REPLICA else "rank"
-        tgt = "all" if self.rank is None else f"{prefix}{self.rank}"
+        if self.stage is not None:
+            tgt = f"stage{self.stage}"
+        elif self.rank is None:
+            tgt = "all"
+        else:
+            tgt = f"{prefix}{self.rank}"
         step = "any" if self.step is None else f"step{self.step}"
         tok = f"{self.kind}-{tgt}-{step}-r{rank}"
         return tok if self.layer == LAYER_WORKER else f"{self.layer}-{tok}"
@@ -158,8 +167,15 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
         bits = target_q.split(":")
         target = bits[0]
         layer = LAYER_WORKER
+        stage: Optional[int] = None
         if target == "all":
             rank = None
+        elif target.startswith("stage") and target[5:].isdigit():
+            # pipeline stage-group fault domain: matches every rank of
+            # the stage group (parallel/mpmd sets RLA_TPU_PIPELINE_STAGE
+            # in each member's env; the injector filters on it)
+            rank = None
+            stage = int(target[5:])
         elif target.startswith("rank") and target[4:].isdigit():
             rank = int(target[4:])
         elif target.startswith("replica") and target[7:].isdigit():
@@ -173,7 +189,7 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
         else:
             raise ValueError(
                 f"chaos fault {part!r}: target must be 'rankN', "
-                f"'replicaN' or 'all', got {target!r}")
+                f"'replicaN', 'stageN' or 'all', got {target!r}")
         step: Optional[int] = None
         delay: Optional[float] = None
         once = False
@@ -214,7 +230,7 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
             raise ValueError(
                 f"chaos fault {part!r}: only 'slow' takes a delay")
         faults.append(ChaosFault(kind, rank, step, delay, once,
-                                 layer=layer))
+                                 layer=layer, stage=stage))
     return faults
 
 
@@ -235,9 +251,13 @@ class ChaosInjector:
     def __init__(self, faults: List[ChaosFault], rank: int,
                  freeze_heartbeat: Optional[Callable[[], None]] = None,
                  ns_dir: Optional[str] = None,
-                 layer: str = LAYER_WORKER):
+                 layer: str = LAYER_WORKER,
+                 stage: Optional[int] = None):
         self.layer = layer
-        self.faults = [f for f in faults if f.layer == layer]
+        # stage-targeted faults only arm inside their own stage group
+        # (``stage`` = this process's RLA_TPU_PIPELINE_STAGE, if any)
+        self.faults = [f for f in faults if f.layer == layer
+                       and (f.stage is None or f.stage == stage)]
         self.rank = rank
         self.freeze_heartbeat = freeze_heartbeat
         self.ns_dir = ns_dir
@@ -280,7 +300,8 @@ class ChaosInjector:
         if not spec:
             return None
         inj = cls(parse_chaos(spec), rank, freeze_heartbeat,
-                  knobs.get_raw(CHAOS_NS_ENV) or None, layer=layer)
+                  knobs.get_raw(CHAOS_NS_ENV) or None, layer=layer,
+                  stage=knobs.get_int("RLA_TPU_PIPELINE_STAGE", None))
         return inj if inj.faults else None
 
     def _lost_marker(self, fault: ChaosFault) -> str:

@@ -1,0 +1,207 @@
+"""MPMD pipeline-bubble probe on forced-host-platform CPU workers.
+
+Self-contained: forces ``JAX_PLATFORMS=cpu`` BEFORE importing jax, so it
+produces a real number on any machine — including one whose accelerator
+backend is wedged, which is exactly when bench.py falls back to it.
+
+One PipelineRunner fit (parallel/mpmd/): S=2 stage groups over spawned
+actor-pool workers, 1F1B over M=4 microbatches, activations handed off
+through the shm object store.  Per-stage compute is sized so the matmul
+chain dominates the mailbox/IPC handoff cost (tiny models measure the
+transport, not the schedule) and the steady-state measured bubble
+fraction lands on the analytic 1F1B bubble (S-1)/(M+S-1) = 1/5.
+
+The headline value is the bubble accuracy
+
+    1 - |measured - analytic| / analytic
+
+over the steady-state steps (step 1 pays per-stage compiles and is
+excluded).  The acceptance bar is > 0.8 — measured within 20% of
+analytic — asserted here AND pinned as a PERF_BASELINE.json floor.  The
+probe also asserts the cross-stage evidence trail: every per-step row
+carries both stages' busy/wall ticks, and both ranks' spilled
+``pipeline_tick`` events in run_report.json stitch under the run's one
+trace id.
+
+Emits one bench.py-shaped JSON line on stdout, with the bench-honesty
+compile-count record and the telemetry snapshot printed BEFORE it (the
+parser takes the newest value-bearing line)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+STAGES = 2
+MICROBATCHES = 4
+STEPS = 5
+DIM = 1024       # every layer is a DIM x DIM matmul: compute-bound ticks
+ROWS = 1024      # rows per batch -> ROWS/MICROBATCHES per microbatch
+
+# One XLA compute thread per stage worker: the analytic bubble assumes
+# CONSTANT tick time, but multi-threaded workers contend for host cores
+# exactly when the schedule overlaps them (steady state) and run alone
+# at full speed inside the bubble windows — which compresses the
+# measured bubble below analytic.  Single-threaded workers on a
+# multi-core host never contend, so tick time is overlap-independent.
+_WORKER_XLA = ("--xla_force_host_platform_device_count=1 "
+               "--xla_cpu_multi_thread_eigen=false "
+               "intra_op_parallelism_threads=1")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_accelerators_tpu import TpuModule, native
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
+    from ray_lightning_accelerators_tpu.parallel.mpmd.driver import (
+        PipelineRunner)
+    from ray_lightning_accelerators_tpu.parallel.mpmd.schedule import (
+        analytic_bubble_fraction)
+
+    if not native.available():
+        raise RuntimeError(
+            f"pipeline probe needs the native shm object store for "
+            f"activation handoff: {native.build_error()}")
+
+    cg.install()
+    workdir = tempfile.mkdtemp(prefix="rla_pipeline_probe_")
+
+    class ProbeModel(TpuModule):
+        """Depth-4 tanh MLP, DIM x DIM per layer, cut into 2 stages of
+        2 contiguous layers — uniform per-stage cost, so the analytic
+        1F1B bubble applies directly."""
+
+        DEPTH = 4
+
+        def init_params(self, rng):
+            keys = jax.random.split(rng, self.DEPTH)
+            return {
+                f"l{i}": {
+                    "w": jax.random.normal(
+                        keys[i], (DIM, DIM), jnp.float32) * 0.02,
+                    "b": jnp.zeros((DIM,), jnp.float32),
+                }
+                for i in range(self.DEPTH)
+            }
+
+        @staticmethod
+        def _layer_indices(layers):
+            return sorted(int(name[1:]) for name in layers)
+
+        def _apply(self, layers, x):
+            for i in self._layer_indices(layers):
+                p = layers[f"l{i}"]
+                x = jnp.tanh(x @ p["w"] + p["b"])
+            return x
+
+        def forward(self, params, x):
+            return self._apply(params, x)
+
+        def training_step(self, params, batch, rng):
+            loss = jnp.mean((self._apply(params, batch) - 1.0) ** 2)
+            return loss, {"loss": loss}
+
+        def configure_optimizers(self):
+            return optax.sgd(0.01)
+
+        def pipeline_stage_params(self, params, stage, num_stages):
+            per = self.DEPTH // num_stages
+            return {f"l{i}": params[f"l{i}"]
+                    for i in range(stage * per, (stage + 1) * per)}
+
+        def pipeline_stage_forward(self, stage_params, x, stage,
+                                   num_stages):
+            return self._apply(stage_params, x)
+
+        def pipeline_loss(self, y, batch):
+            loss = jnp.mean((y - 1.0) ** 2)
+            return loss, {"loss": loss}
+
+    rng = np.random.default_rng(0)
+    batches = [rng.standard_normal((ROWS, DIM)).astype(np.float32)
+               for _ in range(STEPS)]
+
+    runner = PipelineRunner(
+        ProbeModel(), num_stages=STAGES, num_microbatches=MICROBATCHES,
+        schedule="1f1b", seed=0, workdir=workdir,
+        ckpt_every=10 ** 9,  # checkpoint cadence off the measured path
+        worker_env={"XLA_FLAGS": _WORKER_XLA})
+    try:
+        summary = runner.run(batches)
+    finally:
+        runner.shutdown()
+
+    analytic = analytic_bubble_fraction(STAGES, MICROBATCHES)
+    assert summary["analytic_bubble_fraction"] == analytic
+
+    # steady state only: step 1's ticks carry every stage's compiles
+    rows = summary["steps"][1:]
+    measured = sum(r["bubble_frac"] for r in rows) / len(rows)
+    accuracy = 1.0 - abs(measured - analytic) / analytic
+    assert accuracy > 0.8, (
+        f"measured bubble {measured:.4f} is not within 20% of analytic "
+        f"{analytic:.4f} (accuracy {accuracy:.3f}) — per-stage compute "
+        "no longer dominates the handoff cost")
+
+    # zero steady-state retraces: the per-step compile count freezes
+    compiles = [r["compiles"] for r in summary["steps"]]
+    assert len(set(compiles[1:])) == 1, compiles
+
+    # stitched cross-stage timeline: every step row carries both stages'
+    # ticks, and both ranks' spilled tick events share the one trace id
+    for row in summary["steps"]:
+        keys = {k.split("/")[0] for k in row["per_stage"]}
+        assert keys == {str(s) for s in range(STAGES)}, row["per_stage"]
+    report = json.load(open(os.path.join(workdir, "run_report.json")))
+    assert report["error"] is None
+    assert report["trace_id"] == summary["trace_id"]
+    for rank in (str(r) for r in range(STAGES)):
+        ticks = [e for e in report["ranks"][rank]["events"]
+                 if e.get("kind") == "pipeline_tick"]
+        assert ticks, f"rank {rank} spilled no pipeline ticks"
+        assert all(t["trace"] == summary["trace_id"] for t in ticks)
+
+    record = {
+        "metric": "pipeline_bubble_accuracy",
+        "value": round(accuracy, 4),
+        "unit": "frac",
+        "measured_bubble_fraction": round(measured, 4),
+        "analytic_bubble_fraction": round(analytic, 4),
+        "schedule": summary["schedule"],
+        "num_stages": STAGES,
+        "num_microbatches": MICROBATCHES,
+        "steady_steps": len(rows),
+        "step_wall_s": round(sum(r["wall_s"] for r in rows) / len(rows), 4),
+        "replays": summary["replays"],
+        "trace_id": summary["trace_id"],
+        "platform": "cpu-forced-host",
+        "note": "value = 1 - |measured - analytic| / analytic for the "
+                "1F1B bubble (S-1)/(M+S-1) over steady-state steps on "
+                "2 stage groups x 4 microbatches; bar is > 0.8 "
+                "(measured within 20% of analytic)",
+        # the bar: within-20%-of-analytic (PERF_BASELINE.json floor)
+        "vs_baseline": round(accuracy / 0.8, 3),
+    }
+    compile_rec = cg.compile_count_record("pipeline")
+    print(json.dumps(compile_rec), flush=True)
+    from ray_lightning_accelerators_tpu.telemetry import (
+        probe_snapshot_record)
+    print(json.dumps(probe_snapshot_record("pipeline")), flush=True)
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -154,7 +154,9 @@ def _chaos_leak_guard(request):
     treatment: left ambient it would install SIGTERM notice handlers in
     every spawned worker of unrelated tests."""
     allowed = (request.node.get_closest_marker("chaos") is not None
-               or request.node.get_closest_marker("preempt") is not None)
+               or request.node.get_closest_marker("preempt") is not None
+               or request.node.get_closest_marker("pipeline_mpmd")
+               is not None)
     if not allowed:
         assert "RLA_TPU_CHAOS" not in os.environ, (
             f"RLA_TPU_CHAOS leaked into non-chaos test {request.node.nodeid}"

@@ -78,8 +78,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     """main() with a dead backend: the death record comes FIRST, no
     accelerator bench ever ran -- and the CPU-mesh fallback benches
     (gradexchange/input_pipeline/fsdp_exchange/paged_serve/
-    mfu_overlap/perf_observatory/live_plane/serve_resilience/resize)
-    still land REAL metric lines next
+    mfu_overlap/perf_observatory/live_plane/serve_resilience/resize/
+    pipeline) still land REAL metric lines next
     to the death record, so the window exits 0 and the driver records
     numbers (all five earlier BENCH rounds were rc=2 with zero real
     numbers; this pins the fix).  The fallbacks are faked here (the
@@ -130,13 +130,17 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_resize",
         lambda: {"metric": "resize_inmem_vs_ckpt_downtime_ratio",
                  "value": 3.7, "unit": "x", "vs_baseline": 1.16})
+    monkeypatch.setattr(
+        bench, "bench_pipeline",
+        lambda: {"metric": "pipeline_bubble_accuracy",
+                 "value": 0.96, "unit": "frac", "vs_baseline": 1.2})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 10
+    assert len(lines) == 11
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
@@ -148,6 +152,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert lines[7]["metric"] == "live_plane_scrape_validity"
     assert lines[8]["metric"] == "serve_resilience_completed_fraction"
     assert lines[9]["metric"] == "resize_inmem_vs_ckpt_downtime_ratio"
+    assert lines[10]["metric"] == "pipeline_bubble_accuracy"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -166,7 +171,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         "perf_observatory_phase_coverage",
         "live_plane_scrape_validity",
         "serve_resilience_completed_fraction",
-        "resize_inmem_vs_ckpt_downtime_ratio"]
+        "resize_inmem_vs_ckpt_downtime_ratio",
+        "pipeline_bubble_accuracy"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -185,6 +191,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_serve_resilience",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_resize",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_pipeline",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -245,6 +253,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_resize",
         lambda: {"metric": "resize_inmem_vs_ckpt_downtime_ratio",
                  "value": 3.7, "unit": "x", "vs_baseline": 1.16})
+    monkeypatch.setattr(
+        bench, "bench_pipeline",
+        lambda: {"metric": "pipeline_bubble_accuracy",
+                 "value": 0.96, "unit": "frac", "vs_baseline": 1.2})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -263,7 +275,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "perf_observatory_phase_coverage",
         "live_plane_scrape_validity",
         "serve_resilience_completed_fraction",
-        "resize_inmem_vs_ckpt_downtime_ratio"]
+        "resize_inmem_vs_ckpt_downtime_ratio",
+        "pipeline_bubble_accuracy"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)

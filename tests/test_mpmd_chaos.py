@@ -1,0 +1,122 @@
+"""Per-stage fault domains: chaos faults targeted at one stage group
+(``crash@stage1`` / ``hang@stage1``) are attributed to that stage,
+recovered via checkpoint replay, and charged against that stage's
+budget only.  Chaos specs ride ``worker_env`` (never the driver env) and
+use ``:once`` + a cross-restart claim namespace so a replayed worker
+generation does not re-fire the fault."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu import native
+from ray_lightning_accelerators_tpu.parallel.mpmd.driver import (
+    PipelineRunner, PipelineStageFailed)
+from tests.utils import PipelineBoringModel
+
+pytestmark = [
+    pytest.mark.pipeline_mpmd,
+    pytest.mark.chaos,
+    pytest.mark.skipif(not native.available(),
+                       reason=f"native build: {native.build_error()}"),
+]
+
+
+@pytest.fixture
+def batches():
+    rng = np.random.default_rng(0)
+    return [rng.standard_normal((8, 8)).astype(np.float32)
+            for _ in range(3)]
+
+
+def _chaos_env(tmpdir, spec):
+    ns = os.path.join(str(tmpdir), "chaos-ns")
+    os.makedirs(ns, exist_ok=True)
+    return {"RLA_TPU_CHAOS": spec, "RLA_TPU_CHAOS_NS": ns}
+
+
+def _clean_losses(batches):
+    """What the unfaulted pipeline produces — replay must reproduce it."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    mod = PipelineBoringModel()
+    params = mod.init_params(jax.random.PRNGKey(0))
+    tx = mod.configure_optimizers()
+    opt = tx.init(params)
+    losses = []
+    for batch in batches:
+        g_acc = jax.tree.map(jnp.zeros_like, params)
+        loss_sum = 0.0
+        for mb in np.split(batch, 4):
+            loss, g = jax.value_and_grad(
+                lambda p, xb: mod.training_step(p, xb, None)[0])(params, mb)
+            g_acc = jax.tree.map(jnp.add, g_acc, g)
+            loss_sum += float(loss)
+        updates, opt = tx.update(
+            jax.tree.map(lambda a: a / 4, g_acc), opt, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(loss_sum / 4)
+    return losses
+
+
+def test_crash_at_stage1_replays_within_stage_budget(tmpdir, batches):
+    """crash@stage1 at training step 1: the run completes via checkpoint
+    replay, the failure is charged to stage 1 and stage 0's budget is
+    untouched, and the replayed trajectory is exact."""
+    runner = PipelineRunner(
+        PipelineBoringModel(), num_stages=2, num_microbatches=4, seed=0,
+        workdir=str(tmpdir),
+        worker_env=_chaos_env(tmpdir, "crash@stage1:step2:once"))
+    try:
+        summary = runner.run(batches)
+    finally:
+        runner.shutdown()
+    assert summary["replays"] == 1
+    assert summary["stage_failure_budget_used"] == [0, 1]
+    np.testing.assert_allclose(summary["losses"], _clean_losses(batches),
+                               rtol=1e-6)
+    report = json.load(open(os.path.join(str(tmpdir), "run_report.json")))
+    assert report["error"] is None
+
+
+def test_hang_at_stage1_reaped_and_replayed(tmpdir, batches):
+    """hang@stage1: the watchdog reaps the wedged stage-1 worker (stage
+    0 only ever sees a handoff timeout, which must NOT win attribution)
+    and the run completes via replay."""
+    runner = PipelineRunner(
+        PipelineBoringModel(), num_stages=2, num_microbatches=4, seed=0,
+        workdir=str(tmpdir), handoff_timeout_s=12.0, wedge_timeout_s=4.0,
+        worker_env=_chaos_env(tmpdir, "hang@stage1:step2:once"))
+    try:
+        summary = runner.run(batches[:2])
+    finally:
+        runner.shutdown()
+    assert summary["replays"] == 1
+    assert summary["stage_failure_budget_used"] == [0, 1]
+    np.testing.assert_allclose(summary["losses"],
+                               _clean_losses(batches)[:2], rtol=1e-6)
+
+
+def test_exhausted_stage_budget_fails_typed_with_attribution(tmpdir,
+                                                             batches):
+    """Without ``:once`` the fault re-fires on every replayed generation;
+    past max_stage_failures the run fails as PipelineStageFailed naming
+    the faulting stage group."""
+    runner = PipelineRunner(
+        PipelineBoringModel(), num_stages=2, num_microbatches=4, seed=0,
+        workdir=str(tmpdir), max_stage_failures=1,
+        worker_env={"RLA_TPU_CHAOS": "crash@stage1:step2"})
+    with pytest.raises(PipelineStageFailed) as exc_info:
+        try:
+            runner.run(batches)
+        finally:
+            runner.shutdown()
+    err = exc_info.value
+    assert err.stage == 1
+    assert err.budget_used == [0, 2]  # stage 0 never cross-charged
+    report = json.load(open(os.path.join(str(tmpdir), "run_report.json")))
+    assert report["error"]["type"] == "PipelineStageFailed"
+    assert report["extra"]["pipeline"]["stage_failure_budget_used"] == [0, 2]

@@ -1,0 +1,109 @@
+"""Unit tests for the MPMD pipeline tick programs (parallel/mpmd/schedule.py):
+pure schedule math, no processes, no jax arrays."""
+
+import pytest
+
+from ray_lightning_accelerators_tpu.parallel.mpmd import schedule as sched
+from ray_lightning_accelerators_tpu.parallel.mpmd.schedule import (
+    OP_BWD, OP_FWD, OP_OPT, OP_RECV_ACT, OP_RECV_GRAD, OP_SEND_ACT,
+    OP_SEND_GRAD, PipelineScheduleError, Slot, analytic_bubble_fraction,
+    audit_programs, build_programs, program_fingerprint, stage_program)
+
+
+def _ops(program):
+    return [s.op for s in program]
+
+
+def _compute_slots(program, op):
+    return [s.microbatch for s in program if s.op == op]
+
+
+class TestStageProgram:
+    def test_first_stage_1f1b_two_stages(self):
+        prog = stage_program("1f1b", 0, 2, 4)
+        # warmup of S-1-stage=1 fwd, then steady 1F1B, drain, opt
+        assert _compute_slots(prog, OP_FWD) == [0, 1, 2, 3]
+        assert _compute_slots(prog, OP_BWD) == [0, 1, 2, 3]
+        assert prog[-1] == Slot(OP_OPT, -1)
+        # stage 0 sends every activation and receives every gradient
+        assert _compute_slots(prog, OP_SEND_ACT) == [0, 1, 2, 3]
+        assert _compute_slots(prog, OP_RECV_GRAD) == [0, 1, 2, 3]
+        assert OP_RECV_ACT not in _ops(prog)
+        assert OP_SEND_GRAD not in _ops(prog)
+
+    def test_last_stage_interleaves_immediately(self):
+        prog = stage_program("1f1b", 1, 2, 4)
+        # last stage has zero warmup: fwd0 then bwd0 right away
+        compute = [s for s in prog if s.op in (OP_FWD, OP_BWD)]
+        assert [(s.op, s.microbatch) for s in compute[:4]] == [
+            (OP_FWD, 0), (OP_BWD, 0), (OP_FWD, 1), (OP_BWD, 1)]
+        assert OP_SEND_ACT not in _ops(prog)
+        assert OP_RECV_GRAD not in _ops(prog)
+
+    def test_gpipe_runs_all_forwards_first(self):
+        prog = stage_program("gpipe", 0, 2, 4)
+        ops = [s.op for s in prog if s.op in (OP_FWD, OP_BWD)]
+        assert ops == [OP_FWD] * 4 + [OP_BWD] * 4
+
+    def test_1f1b_warmup_depth_scales_with_distance_to_last(self):
+        # stage 0 of 4 stages: warmup = S-1-stage = 3 forwards
+        prog = stage_program("1f1b", 0, 4, 8)
+        ops = [s.op for s in prog if s.op in (OP_FWD, OP_BWD)]
+        # 3 warmup forwards, then strict one-forward-one-backward pairs
+        assert ops[:3] == [OP_FWD] * 3
+        assert ops[3:7] == [OP_FWD, OP_BWD, OP_FWD, OP_BWD]
+
+    def test_every_stage_ends_with_opt(self):
+        for sch in sched.SCHEDULES:
+            for stage in range(3):
+                prog = stage_program(sch, stage, 3, 6)
+                assert prog[-1] == Slot(OP_OPT, -1)
+
+    def test_unknown_schedule_refused(self):
+        with pytest.raises(PipelineScheduleError, match="schedule"):
+            stage_program("interleaved", 0, 2, 4)
+
+    def test_bad_shape_refused(self):
+        with pytest.raises(PipelineScheduleError):
+            stage_program("1f1b", 2, 2, 4)  # stage out of range
+        with pytest.raises(PipelineScheduleError):
+            stage_program("1f1b", 0, 2, 0)  # no microbatches
+
+
+class TestAuditAndFingerprint:
+    def test_build_programs_audits_clean(self):
+        for sch in sched.SCHEDULES:
+            progs = build_programs(sch, 4, 8)
+            assert audit_programs(progs) is None
+
+    def test_audit_flags_deadlock(self):
+        progs = list(build_programs("1f1b", 2, 4))
+        # corrupt stage 1: its first recv waits for a microbatch no one
+        # ever sends -> stage 1 blocks at slot 0, stage 0 starves on grads
+        bad = [Slot(OP_RECV_ACT, 7) if s == Slot(OP_RECV_ACT, 0) else s
+               for s in progs[1]]
+        progs[1] = bad
+        diag = audit_programs(progs)
+        assert diag is not None
+        assert diag["deadlocked_stages"] == [0, 1]
+        blocked = diag["per_stage"]["1"]
+        assert blocked["op"] == OP_RECV_ACT
+        assert blocked["waiting_for"] == ("act", 0, 7)
+
+    def test_fingerprint_deterministic_and_distinct(self):
+        a = program_fingerprint(stage_program("1f1b", 0, 2, 4))
+        b = program_fingerprint(stage_program("1f1b", 0, 2, 4))
+        c = program_fingerprint(stage_program("gpipe", 0, 2, 4))
+        assert a == b
+        assert a != c
+
+
+class TestBubbleMath:
+    def test_analytic_fraction(self):
+        assert analytic_bubble_fraction(1, 4) == 0.0
+        assert analytic_bubble_fraction(2, 4) == pytest.approx(1 / 5)
+        assert analytic_bubble_fraction(4, 8) == pytest.approx(3 / 11)
+
+    def test_more_microbatches_shrink_the_bubble(self):
+        fracs = [analytic_bubble_fraction(4, m) for m in (4, 8, 16, 64)]
+        assert fracs == sorted(fracs, reverse=True)

@@ -95,3 +95,43 @@ def test_cross_process_deref_via_actor():
                 float(big.sum())
         finally:
             w.shutdown()
+
+
+def test_total_shm_bytes_tracks_put_and_delete():
+    """The ``object_store_shm`` gauge: live bytes rise on put, fall on
+    delete, and the module-level reader never instantiates a store."""
+    from ray_lightning_accelerators_tpu.runtime import object_store as osl
+    with ObjectStore() as store:
+        assert store.total_shm_bytes() == 0
+        ref1 = store.put({"w": np.zeros((256, 256), dtype=np.float32)})
+        ref2 = store.put({"w": np.zeros((128, 128), dtype=np.float32)})
+        assert store.total_shm_bytes() == 256 * 256 * 4 + 128 * 128 * 4
+        store.delete(ref1)
+        assert store.total_shm_bytes() == 128 * 128 * 4
+        store.delete(ref2)
+        assert store.total_shm_bytes() == 0
+
+
+def test_global_shm_bytes_reader_never_builds_a_store():
+    from ray_lightning_accelerators_tpu.runtime import object_store as osl
+    before = osl._GLOBAL
+    assert osl.global_shm_bytes() >= 0
+    assert osl._GLOBAL is before  # sampling must not instantiate one
+
+
+def test_release_unmaps_one_refs_views_only():
+    """release(ref) drops exactly that ref's copy=False mappings (the
+    pipeline receiver's step-boundary cleanup); other refs' views stay
+    valid, and a released ref can be re-mapped by a later get."""
+    with ObjectStore() as store:
+        ref_a = store.put({"w": np.full((256, 256), 3.0, dtype=np.float32)})
+        ref_b = store.put({"w": np.full((256, 256), 7.0, dtype=np.float32)})
+        va = store.get(ref_a, copy=False)
+        vb = store.get(ref_b, copy=False)
+        store.release(ref_a)
+        # b's view survives a's release
+        assert float(vb["w"][0, 0]) == 7.0
+        # a remains stored: a fresh get re-maps it
+        again = store.get(ref_a)
+        assert float(again["w"][0, 0]) == 3.0
+        store.release(ref_b)

@@ -89,3 +89,25 @@ def test_gpt_pipeline_matches_plain(tmpdir):
     for a, b in zip(jax.tree.leaves(m1.params), jax.tree.leaves(m2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-3)
+
+
+@pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) >= (0, 5),
+    reason="the dp>1 x pp>1 SPMD composition works on jax >= 0.5; the "
+           "typed refusal only guards 0.4.x")
+def test_dp_times_pp_refused_typed_on_jax04():
+    """Regression for the skipif above (test_gpt_trains_with_pipeline):
+    on jax 0.4.x the dp>1 x pp>1 composition must fail EAGERLY as a
+    PipelineCompatError naming the alternatives, not as a deep XLA
+    'PartitionId instruction is not supported' crash mid-compile."""
+    from ray_lightning_accelerators_tpu.parallel.pipeline import (
+        PipelineCompatError)
+    mesh = Accelerator(MeshConfig(data=2, pipeline=2)).build_mesh()
+    params = _layers_params(n_layers=4)
+    x = jnp.ones((8, 16))
+    with pytest.raises(PipelineCompatError) as exc_info:
+        jax.jit(lambda p, xx: pipeline_apply(
+            _stage_fn, p, xx, mesh, 4))(params, x)
+    msg = str(exc_info.value)
+    assert "jax >= 0.5" in msg
+    assert "pipeline_stages" in msg  # points at the MPMD alternative

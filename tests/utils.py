@@ -203,3 +203,68 @@ def assert_prometheus_exposition(text: str) -> None:
             continue
         assert PROM_SAMPLE_RE.match(line), \
             f"malformed exposition line: {line!r}"
+
+
+class PipelineBoringModel(TpuModule):
+    """BoringModel stretched to a depth-4 tanh MLP cut into contiguous
+    pipeline stages: the MPMD parity/chaos fixture (tests/test_mpmd_*).
+
+    Stage hooks slice the layer dict by global layer index, so the same
+    params train identically through the single-process baseline
+    (training_step) and the PipelineRunner (pipeline_stage_*)."""
+
+    DEPTH = 4
+
+    def __init__(self, dim: int = 8, hidden: int = 16, lr: float = 0.1):
+        super().__init__()
+        self.dim, self.hidden, self.lr = dim, hidden, lr
+
+    def init_params(self, rng):
+        keys = jax.random.split(rng, self.DEPTH)
+        sizes = [self.dim] + [self.hidden] * (self.DEPTH - 1) + [self.dim]
+        return {
+            f"l{i}": {
+                "w": jax.random.normal(
+                    keys[i], (sizes[i], sizes[i + 1]), jnp.float32) * 0.3,
+                "b": jnp.zeros((sizes[i + 1],), jnp.float32),
+            }
+            for i in range(self.DEPTH)
+        }
+
+    @staticmethod
+    def _layer_indices(layers):
+        return sorted(int(name[1:]) for name in layers)
+
+    def _apply(self, layers, x):
+        for i in self._layer_indices(layers):
+            p = layers[f"l{i}"]
+            x = jnp.tanh(x @ p["w"] + p["b"])
+        return x
+
+    # -- single-process baseline path ---------------------------------- #
+    def forward(self, params, x):
+        return self._apply(params, x)
+
+    def training_step(self, params, batch, rng):
+        loss = jnp.mean((self._apply(params, batch) - 1.0) ** 2)
+        return loss, {"loss": loss}
+
+    def configure_optimizers(self):
+        return optax.sgd(self.lr)
+
+    # -- MPMD pipeline hooks ------------------------------------------- #
+    def pipeline_stage_params(self, params, stage, num_stages):
+        if self.DEPTH % num_stages:
+            raise ValueError(
+                f"{self.DEPTH} layers do not divide into "
+                f"{num_stages} stages")
+        per = self.DEPTH // num_stages
+        return {f"l{i}": params[f"l{i}"]
+                for i in range(stage * per, (stage + 1) * per)}
+
+    def pipeline_stage_forward(self, stage_params, x, stage, num_stages):
+        return self._apply(stage_params, x)
+
+    def pipeline_loss(self, y, batch):
+        loss = jnp.mean((y - 1.0) ** 2)
+        return loss, {"loss": loss}
