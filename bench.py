@@ -775,6 +775,19 @@ def bench_pipeline() -> dict:
     return _run_cpu_probe("pipeline_probe.py", "pipeline")
 
 
+def bench_prefix_affinity() -> dict:
+    """Prefix-affinity routing bench (serve/controller.py +
+    serve/engine.py): a skewed shared-prefix workload (4 hot 384-token
+    prefix families, shuffled arrivals) is served by a 3-replica tier
+    twice — least-loaded spray vs prefix-affinity routing — and the
+    value is the steady-state p99 TTFT ratio least-loaded/affinity
+    (must be strictly > 1), plus a disaggregated 1-prefill/2-decode
+    lane pass whose decode cadence and KV-handoff counts ride along as
+    fields, on a forced-host-platform CPU mesh (see
+    ``_run_cpu_probe``)."""
+    return _run_cpu_probe("prefix_affinity_probe.py", "prefix_affinity")
+
+
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
            "input_pipeline": bench_input_pipeline,
@@ -784,7 +797,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "perf_observatory": bench_perf_observatory,
            "live_plane": bench_live_plane,
            "serve_resilience": bench_serve_resilience,
-           "resize": bench_resize, "pipeline": bench_pipeline}
+           "resize": bench_resize, "pipeline": bench_pipeline,
+           "prefix_affinity": bench_prefix_affinity}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -810,7 +824,8 @@ if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
 _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
                          "perf_observatory", "live_plane",
-                         "serve_resilience", "resize", "pipeline")
+                         "serve_resilience", "resize", "pipeline",
+                         "prefix_affinity")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -914,7 +929,8 @@ def main() -> None:
         "--benches",
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
                 "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory,"
-                "live_plane,serve_resilience,resize,pipeline",
+                "live_plane,serve_resilience,resize,pipeline,"
+                "prefix_affinity",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--gate", action="store_true",
                         help="run no benches: gate a bench window "

@@ -179,6 +179,20 @@ _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
 _register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
                "preemption grace budget in seconds; setting it installs "
                "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_SERVE_AFFINITY", "bool", True,
+               "prefix-affinity routing: send a request to the replica "
+               "whose KV cache holds the longest resident run of its "
+               "chain-hashed prefix keys (breaker/drain states always "
+               "override; hedges are deliberate misses) "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_AFFINITY_RESIDENCY", "int", 4096,
+               "per-replica cap on tracked prefix-key residency (LRU); "
+               "bounds router memory, not the replica's real cache "
+               "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_AFFINITY_VNODES", "int", 32,
+               "virtual nodes per replica on the prefix-affinity "
+               "consistent-hash ring; cold keys place on their ring "
+               "owner so repeats converge (serve/controller.py)"))
 _register(Knob("RLA_TPU_SERVE_BREAKER_FAILURES", "int", 3,
                "serve circuit breaker: failures in the rolling window "
                "before the reopen backoff starts growing exponentially "
@@ -191,6 +205,16 @@ _register(Knob("RLA_TPU_SERVE_BROWNOUT_FRAC", "float", 0.9,
                "queue-depth fraction past which a saturated tier with "
                "no scale-up headroom sheds typed BrownoutShed "
                "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_HANDOFF_MIN_BLOCKS", "int", 1,
+               "minimum full prompt blocks before a request takes the "
+               "prefill-lane + KV-handoff path (below it the request "
+               "serves end-to-end on a decode-lane replica) "
+               "(serve/replicas.py)"))
+_register(Knob("RLA_TPU_SERVE_HANDOFF_WAVE_BYTES", "int", 4 << 20,
+               "per-wave byte bound on the KV block copy a prefill->"
+               "decode handoff ships through the object store "
+               "(parallel/redistribute.py wave_schedule; "
+               "serve/engine.py)"))
 _register(Knob("RLA_TPU_SERVE_HEDGE", "bool", True,
                "hedged re-dispatch of a slow replica's oldest in-flight "
                "chunk onto a healthy replica (serve/controller.py)"))
@@ -200,6 +224,10 @@ _register(Knob("RLA_TPU_SERVE_MAX_REPLICAS", "int", None,
 _register(Knob("RLA_TPU_SERVE_MAX_RETRIES", "int", 2,
                "per-request infra-failure retry budget before a serve "
                "request fails typed (serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_PREFILL_REPLICAS", "int", 0,
+               "replicas dedicated to the prefill lane (lowest ranks); "
+               "0 disables disaggregated lanes and every replica serves "
+               "end-to-end (serve/controller.py)"))
 _register(Knob("RLA_TPU_SERVE_RETRY_BACKOFF_S", "float", 0.02,
                "base seconds of the serve request-retry exponential "
                "backoff (utils/backoff.py schedule; "

@@ -1103,6 +1103,30 @@ class GPT(TpuModule):
         return {"k": put(pool["k"], row_cache["k"]),
                 "v": put(pool["v"], row_cache["v"])}
 
+    @staticmethod
+    def paged_blocks_gather(pool, blocks):
+        """Read physical ``blocks`` ([W] int32, traced) out of a paged
+        pool: ``(k, v)`` each [L, W, kv_heads, block_len, head_dim].
+        The serve tier's KV-handoff EXPORT: a prefill-lane engine
+        gathers a request's blocks wave-by-wave for the object-store
+        copy to a decode replica.  Callers pad ``blocks`` to a fixed
+        wave width with the garbage block 0 so one program covers every
+        wave (a handoff must never recompile)."""
+        return pool["k"][:, blocks], pool["v"][:, blocks]
+
+    @staticmethod
+    def paged_blocks_scatter(pool, blocks, k, v):
+        """Write block payloads ``k``/``v`` ([L, W, H, block_len, D])
+        into physical ``blocks`` ([W] int32, traced) of a paged pool —
+        the KV-handoff IMPORT (the block-id remap made real: same
+        bytes, new physical ids).  Pad entries target the garbage block
+        0, where last-write-wins garbage is harmless by the same
+        argument as inactive decode rows."""
+        return {"k": pool["k"].at[:, blocks].set(k.astype(
+                    pool["k"].dtype)),
+                "v": pool["v"].at[:, blocks].set(v.astype(
+                    pool["v"].dtype))}
+
     def _paged_attn_block(self, h, lp, pk, pv, tables, positions):
         """One layer over the block-paged pool.  h: [B, n, d]; pk/pv:
         [n_blocks, H, block_len, D] (ONE layer's pool); tables: [B, M]

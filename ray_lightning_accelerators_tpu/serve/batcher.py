@@ -102,6 +102,35 @@ def blocks_for_request(prompt_len: int, max_new_tokens: int,
     return -(-top // block_len)
 
 
+def chain_prefix_keys(prompt: Any, block_len: int,
+                      limit: Optional[int] = None) -> List[str]:
+    """Chain-hashed prefix keys for every FULL block of ``prompt`` —
+    key ``j`` commits to tokens ``[0, (j+1)*block_len)``, so equal keys
+    imply equal prefixes and a shared block is reusable only when every
+    earlier block matched too.
+
+    This is the single definition both sides of prefix routing use: the
+    engine's ``BlockAllocator`` prefix index registers these keys
+    (serve/engine.py) and the replica tier's affinity router hashes the
+    SAME keys to pick the replica whose cache already holds the run
+    (serve/controller.py) — computed independently in different
+    processes, they must agree byte-for-byte.  ``limit`` caps the number
+    of keys for the routing side, which only needs enough of the chain
+    to discriminate prefixes, not a digest of a 512k-token prompt."""
+    import hashlib
+
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    n_full = int(prompt.size) // block_len
+    if limit is not None:
+        n_full = min(n_full, limit)
+    h = hashlib.blake2b(digest_size=16)
+    keys: List[str] = []
+    for j in range(n_full):
+        h.update(prompt[j * block_len:(j + 1) * block_len].tobytes())
+        keys.append(h.hexdigest())
+    return keys
+
+
 class RequestRejected(ValueError):
     """The request can never be served by this engine (empty prompt, non
     positive budget, prompt + budget past the cache length).  Not
@@ -148,6 +177,14 @@ class ServeRequest:
     # the controller so engine placement and controller accounting can
     # never disagree (0 = dense engine, no pool accounting)
     blocks_reserved: int = 0
+    # disaggregated lanes (serve/replicas.py): an export request runs
+    # prefill ONLY and resolves with a KV handoff descriptor instead of
+    # tokens; an import request carries the descriptor of a prefill done
+    # elsewhere and starts life mid-decode.  Both preserve the original
+    # t_submit/deadline/trace_id stamps, so the client's SLO clock and
+    # trace survive the lane hop
+    export_handoff: bool = False
+    import_handoff: Optional[Any] = None
 
 
 class ServeResponse:
@@ -257,10 +294,25 @@ class AdmissionController:
         return self._closed
 
     def submit(self, prompt: Any, max_new_tokens: int,
-               speculative: bool = False) -> ServeResponse:
+               speculative: bool = False, *,
+               export_handoff: bool = False,
+               import_handoff: Optional[Any] = None,
+               t_submit: Optional[float] = None,
+               deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> ServeResponse:
         """Admit a request or raise typed: ``RequestRejected`` (can never
         be served), ``QueueFull``/``PoolExhausted`` (backpressure),
-        ``ServeCancelled`` (controller shut down)."""
+        ``ServeCancelled`` (controller shut down).
+
+        Lane handoff (serve/replicas.py): ``export_handoff`` admits a
+        prefill-only request — its block reservation covers the PROMPT
+        bucket alone, never decode growth this engine will not run.  An
+        ``import_handoff`` request bypasses the depth cap like a requeue
+        (it was admitted once at the tier and already cost a prefill);
+        its pool check still applies, it is real memory here.  The
+        ``t_submit``/``deadline``/``trace_id`` overrides carry the
+        ORIGINAL stamps across the hop so a handoff never resets the
+        client's SLO clock or breaks its trace."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise RequestRejected("empty prompt")
@@ -285,7 +337,9 @@ class AdmissionController:
             # paged admission: judge against the pool's budgets, never a
             # dense per-slot length the paging indirection made obsolete
             needed = blocks_for_request(
-                int(prompt.size), int(max_new_tokens), self.block_len,
+                int(prompt.size),
+                1 if export_handoff else int(max_new_tokens),
+                self.block_len,
                 self.spec_headroom if speculative else 0)
             if needed > self.max_blocks_per_slot \
                     or needed > self.pool_blocks:
@@ -306,7 +360,8 @@ class AdmissionController:
         with self._cond:
             if self._closed:
                 raise ServeCancelled("serve queue is shut down")
-            if self._depth >= self.queue_depth:
+            if self._depth >= self.queue_depth \
+                    and import_handoff is None:
                 raise QueueFull(self._depth, self.queue_depth)
             if self.block_len is not None and \
                     self._outstanding_blocks + needed > \
@@ -315,11 +370,18 @@ class AdmissionController:
                                     self.pool_blocks,
                                     self.pool_overcommit)
             req = ServeRequest(next(self._ids), prompt,
-                               int(max_new_tokens), time.monotonic(),
-                               trace_id=mint_trace_id(),
+                               int(max_new_tokens),
+                               (time.monotonic() if t_submit is None
+                                else float(t_submit)),
+                               trace_id=(trace_id if trace_id is not None
+                                         else mint_trace_id()),
                                speculative=bool(speculative),
-                               blocks_reserved=needed)
-            if self.slo_policy is not None \
+                               blocks_reserved=needed,
+                               export_handoff=bool(export_handoff),
+                               import_handoff=import_handoff)
+            if deadline is not None:
+                req.deadline = float(deadline)
+            elif self.slo_policy is not None \
                     and self.slo_policy.deadline_s is not None:
                 req.deadline = req.t_submit + self.slo_policy.deadline_s
             self._outstanding_blocks += needed

@@ -79,6 +79,11 @@ REVIVE_BACKOFF_CAP_ENV = "RLA_TPU_SERVE_REVIVE_BACKOFF_CAP_S"
 MAX_REPLICAS_ENV = "RLA_TPU_SERVE_MAX_REPLICAS"
 SCALE_UP_BURN_ENV = "RLA_TPU_SERVE_SCALE_UP_BURN"
 BROWNOUT_FRAC_ENV = "RLA_TPU_SERVE_BROWNOUT_FRAC"
+AFFINITY_ENV = "RLA_TPU_SERVE_AFFINITY"
+AFFINITY_VNODES_ENV = "RLA_TPU_SERVE_AFFINITY_VNODES"
+AFFINITY_RESIDENCY_ENV = "RLA_TPU_SERVE_AFFINITY_RESIDENCY"
+PREFILL_REPLICAS_ENV = "RLA_TPU_SERVE_PREFILL_REPLICAS"
+HANDOFF_MIN_BLOCKS_ENV = "RLA_TPU_SERVE_HANDOFF_MIN_BLOCKS"
 
 # replica states (the rla_top table vocabulary)
 STATE_OK = "ok"
@@ -86,6 +91,10 @@ STATE_SLOW = "slow"
 STATE_OPEN = "open"            # circuit open: down, waiting out backoff
 STATE_HALF_OPEN = "half-open"  # revival probe in flight
 STATE_DRAINING = "draining"    # scale-down: no new chunks, finishing
+
+# disaggregated lanes (the rla_top "lane" column vocabulary)
+LANE_PREFILL = "prefill"
+LANE_DECODE = "decode"
 
 
 @dataclass(frozen=True)
@@ -130,6 +139,19 @@ class ControllerConfig:
     burn_stale_s: float = 5.0
     brownout: bool = True
     brownout_frac: float = 0.9
+    # prefix-affinity routing: route to the replica whose cache holds
+    # the longest resident run of the request's chain-hashed prefix
+    # keys; health/breaker/drain states always override, hedges count
+    # as deliberate misses
+    affinity: bool = True
+    affinity_vnodes: int = 32
+    affinity_residency: int = 4096
+    # disaggregated lanes: the lowest `prefill_replicas` ranks form a
+    # prefill-heavy lane; prompts with at least `handoff_min_blocks`
+    # full KV blocks prefill there and hand their blocks off to a
+    # decode-lane replica (0 = lanes disabled, end-to-end serving)
+    prefill_replicas: int = 0
+    handoff_min_blocks: int = 1
     # tick cadence
     poll_s: float = 0.1
 
@@ -158,6 +180,15 @@ class ControllerConfig:
                                           cls.scale_up_burn),
             brownout_frac=knobs.get_float(BROWNOUT_FRAC_ENV,
                                           cls.brownout_frac),
+            affinity=knobs.get_bool(AFFINITY_ENV, cls.affinity),
+            affinity_vnodes=knobs.get_int(AFFINITY_VNODES_ENV,
+                                          cls.affinity_vnodes),
+            affinity_residency=knobs.get_int(AFFINITY_RESIDENCY_ENV,
+                                             cls.affinity_residency),
+            prefill_replicas=knobs.get_int(PREFILL_REPLICAS_ENV,
+                                           cls.prefill_replicas),
+            handoff_min_blocks=knobs.get_int(HANDOFF_MIN_BLOCKS_ENV,
+                                             cls.handoff_min_blocks),
         )
         known = {f.name for f in fields(cls)}
         unknown = set(overrides) - known
@@ -165,6 +196,118 @@ class ControllerConfig:
             raise TypeError(f"unknown ControllerConfig fields: "
                             f"{sorted(unknown)}")
         return replace(cfg, **overrides) if overrides else cfg
+
+
+class PrefixAffinityRing:
+    """Consistent-hash ring + per-replica prefix-residency tracking.
+
+    Two structures behind one idea — keep a hot shared prefix's KV
+    blocks on ONE replica instead of re-prefilling it everywhere:
+
+    - **Residency**: a bounded per-replica LRU of the chain-hashed
+      prefix keys (serve/batcher.py ``chain_prefix_keys``) last routed
+      there.  ``resident_run`` scores a candidate by the longest
+      CONSECUTIVE run of a request's keys it holds — the chain hash
+      makes any suffix-after-a-gap unusable, exactly like the
+      allocator's ``lookup_run``.  This is the router's MODEL of each
+      replica's cache, not the cache itself: it is bounded separately
+      (``residency_cap``) and cleared whenever a replica's circuit
+      opens, because a restarted engine comes back blank.
+
+    - **Ring**: ``vnodes`` virtual nodes per rank.  A request whose
+      keys are resident nowhere places on the ring owner of its FIRST
+      key, so repeats of a cold prefix converge on one replica instead
+      of spraying least-loaded; rank arrival/departure only moves the
+      keyspace the consistent hash says it must.
+
+    Not thread-safe on its own: every method is called with the
+    owning controller's lock held."""
+
+    def __init__(self, vnodes: int = 32, residency_cap: int = 4096):
+        import hashlib
+
+        self._hashlib = hashlib
+        self.vnodes = max(1, int(vnodes))
+        self.residency_cap = max(1, int(residency_cap))
+        self._ring: List[Tuple[int, int]] = []   # (point, rank) sorted
+        self._resident: Dict[int, Any] = {}      # rank -> OrderedDict
+
+    def _point(self, token: str) -> int:
+        digest = self._hashlib.blake2b(
+            token.encode(), digest_size=8).digest()
+        return int.from_bytes(digest, "big")
+
+    def add_rank(self, rank: int) -> None:
+        if rank in self._resident:
+            return
+        from collections import OrderedDict
+        self._resident[rank] = OrderedDict()
+        for v in range(self.vnodes):
+            self._ring.append((self._point(f"{rank}:{v}"), rank))
+        self._ring.sort()
+
+    def remove_rank(self, rank: int) -> None:
+        self._resident.pop(rank, None)
+        self._ring = [(p, r) for p, r in self._ring if r != rank]
+
+    def clear_rank(self, rank: int) -> None:
+        """Forget a replica's residency (its engine restarted blank)
+        without moving its keyspace off the ring."""
+        if rank in self._resident:
+            self._resident[rank].clear()
+
+    def owner_among(self, key: str, allowed: Any) -> Optional[int]:
+        """Ring owner of ``key`` restricted to ``allowed`` ranks: the
+        first allowed rank at/after the key's point, wrapping — the
+        consistent-hash successor walk, so an unroutable owner's
+        keyspace falls to its ring successor, not to a reshuffle."""
+        allowed = set(allowed)
+        if not self._ring or not allowed:
+            return None
+        import bisect
+        i = bisect.bisect_left(self._ring, (self._point(key), -1))
+        for j in range(len(self._ring)):
+            rank = self._ring[(i + j) % len(self._ring)][1]
+            if rank in allowed:
+                return rank
+        return None
+
+    def resident_run(self, rank: int, keys: Any) -> int:
+        """Longest consecutive run of ``keys`` (from key 0) the rank's
+        tracked residency holds."""
+        res = self._resident.get(rank)
+        if not res:
+            return 0
+        run = 0
+        for key in keys:
+            if key not in res:
+                break
+            run += 1
+        return run
+
+    def note(self, rank: int, keys: Any) -> None:
+        """MRU-admit ``keys`` into the rank's residency (called at
+        route time: the replica is about to prefill-and-register
+        exactly these keys)."""
+        res = self._resident.get(rank)
+        if res is None:
+            return
+        for key in keys:
+            res.pop(key, None)
+            res[key] = None
+        while len(res) > self.residency_cap:
+            res.popitem(last=False)
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-able ring view for the controller snapshot."""
+        return {
+            "vnodes": self.vnodes,
+            "residency_cap": self.residency_cap,
+            "ranks": sorted(self._resident),
+            "residency": {str(rank): len(res)
+                          for rank, res in sorted(
+                              self._resident.items())},
+        }
 
 
 class _Chunk:
@@ -205,6 +348,10 @@ class ReplicaHealth:
         self.last_detail = ""
         self.last_stats: Dict[str, Any] = {}
         self.p99_step_s: Optional[float] = None
+        self.lane = LANE_DECODE       # disaggregated-lane assignment
+        self.prefix_hits = 0          # affinity routes that found a run
+        self.prefix_misses = 0        # affinity routes that found none
+        self.slo_families: Dict[str, Any] = {}  # per-family SLO rates
         self.slo_burn = 0.0
         self.burn_updated = 0.0       # monotonic ts of the last reading
         self.compile_count: Optional[int] = None
@@ -230,6 +377,13 @@ class ReplicaHealth:
                            and self.open_until > now else 0.0),
             "p99_step_ms": (round(self.p99_step_s * 1e3, 3)
                             if self.p99_step_s is not None else None),
+            "lane": self.lane,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_rate": (
+                round(self.prefix_hits
+                      / (self.prefix_hits + self.prefix_misses), 4)
+                if self.prefix_hits + self.prefix_misses else None),
             "slo_burn": round(float(self.slo_burn), 4),
             "compile_count": self.compile_count,
             "detail": self.last_detail,
@@ -255,6 +409,15 @@ class ReplicaController:
         self._lock = threading.RLock()
         self._replicas: Dict[int, ReplicaHealth] = {
             w.rank: ReplicaHealth(w.rank) for w in group.pool.workers}
+        self.affinity = PrefixAffinityRing(self.cfg.affinity_vnodes,
+                                           self.cfg.affinity_residency)
+        # lane assignment: the lowest `prefill_replicas` ranks form the
+        # prefill lane (deterministic, so a restart reproduces it)
+        for i, rank in enumerate(sorted(self._replicas)):
+            self.affinity.add_rank(rank)
+            if self.cfg.prefill_replicas > 0 \
+                    and i < self.cfg.prefill_replicas:
+                self._replicas[rank].lane = LANE_PREFILL
         self._chunk_ids = itertools.count()
         self._min_replicas = (self.cfg.min_replicas
                               if self.cfg.min_replicas is not None
@@ -291,15 +454,31 @@ class ReplicaController:
     # ------------------------------------------------------------------ #
     # Routing                                                            #
     # ------------------------------------------------------------------ #
-    def route(self, exclude: Any = ()) -> Optional[int]:
+    def route(self, exclude: Any = (),
+              prefix_keys: Optional[Any] = None,
+              lane: Optional[str] = None) -> Optional[int]:
         """The replica the next chunk should go to, or None when no
-        replica can take work right now.  Least-loaded first (in-flight
-        requests, then chunks, then p99); ``slow`` replicas are used
-        only when no healthy replica has capacity."""
+        replica can take work right now.
+
+        Health always wins: open/half-open/draining circuits, dead
+        processes, full in-flight budgets and the exclude set are
+        filtered BEFORE affinity ever looks — a resident prefix on a
+        broken replica is not a destination.  ``lane`` restricts to
+        one disaggregated lane when lanes are enabled, spilling to any
+        lane rather than returning None (availability beats
+        disaggregation).  Within the survivors: the longest resident
+        run of ``prefix_keys`` wins (tier + per-replica hit counted),
+        a cold prefix places on its consistent-hash ring owner so
+        repeats converge (counted as a miss), and with affinity off or
+        no keys it is least-loaded first (in-flight requests, then
+        chunks, then p99).  ``slow`` replicas are used only when no
+        healthy replica has capacity."""
         skip = set(exclude)
         opened: List[Dict[str, Any]] = []
+        counted: Optional[str] = None
+        pick_rank: Optional[int] = None
         with self._lock:
-            best = fallback = None
+            cands: List[Tuple[Tuple[Any, ...], ReplicaHealth]] = []
             for r in self._replicas.values():
                 if r.rank in skip or r.state in (STATE_OPEN,
                                                  STATE_HALF_OPEN,
@@ -313,15 +492,60 @@ class ReplicaController:
                     continue
                 key = (r.inflight_requests, r.inflight_chunks,
                        r.p99_step_s or 0.0)
-                if r.state == STATE_SLOW:
-                    if fallback is None or key < fallback[0]:
-                        fallback = (key, r.rank)
+                cands.append((key, r))
+            if lane is not None and self.cfg.prefill_replicas > 0:
+                in_lane = [c for c in cands if c[1].lane == lane]
+                if in_lane:  # an empty/down lane spills cross-lane
+                    cands = in_lane
+            healthy = [c for c in cands if c[1].state != STATE_SLOW]
+            tier = healthy or cands
+            pick: Optional[ReplicaHealth] = None
+            hit = False
+            if tier and self.cfg.affinity and prefix_keys:
+                best_run, best = 0, None
+                for key, r in tier:
+                    run = self.affinity.resident_run(r.rank,
+                                                     prefix_keys)
+                    if run > best_run or (run == best_run > 0
+                                          and key < best[0]):
+                        best_run, best = run, (key, r)
+                if best is not None:
+                    pick, hit = best[1], True
                 else:
-                    if best is None or key < best[0]:
-                        best = (key, r.rank)
-            pick = best or fallback
+                    owner = self.affinity.owner_among(
+                        prefix_keys[0], [r.rank for _, r in tier])
+                    if owner is not None:
+                        pick = next(r for _, r in tier
+                                    if r.rank == owner)
+            if pick is None and tier:
+                pick = min(tier, key=lambda c: c[0])[1]
+            if pick is not None:
+                pick_rank = pick.rank
+                if self.cfg.affinity and prefix_keys:
+                    if hit:
+                        pick.prefix_hits += 1
+                        counted = "prefix_route_hits"
+                    else:
+                        pick.prefix_misses += 1
+                        counted = "prefix_route_misses"
+                    self.affinity.note(pick.rank, prefix_keys)
         self._emit_opened(opened)
-        return pick[1] if pick is not None else None
+        if counted is not None:  # metrics lock outside the controller's
+            self.metrics.inc(counted)
+        return pick_rank
+
+    def note_import(self, rank: int, prefix_keys: Optional[Any]) -> None:
+        """Record prefix residency a KV IMPORT just landed on ``rank``
+        (the decode replica registered the shipped blocks under their
+        chain keys), WITHOUT counting a route: the request's hit/miss
+        was already accounted where the prefill routed.  Keeps the ring
+        truthful so future same-prefix requests route to the replica
+        that actually holds the KV now."""
+        if not self.cfg.affinity or not prefix_keys:
+            return
+        with self._lock:
+            if rank in self._replicas:
+                self.affinity.note(rank, list(prefix_keys))
 
     def serving_possible(self) -> bool:
         """False only when NO replica can ever take work again: every
@@ -379,6 +603,10 @@ class ReplicaController:
                 burn = stats.get("slo_burn_rate")
                 r.slo_burn = float(burn) if isinstance(
                     burn, (int, float)) else 0.0
+                fam = stats.get("slo_families")
+                if isinstance(fam, dict):
+                    # the ttft-vs-cadence split lane autoscaling reads
+                    r.slo_families = fam
                 r.burn_updated = time.monotonic()
                 cc = stats.get("compile_count")
                 if isinstance(cc, int):
@@ -451,6 +679,10 @@ class ReplicaController:
         prev = r.state
         r.state = STATE_OPEN
         r.last_detail = detail
+        # the revive path rebuilds the engine blank: the router's
+        # residency model must forget, or post-revival affinity would
+        # "hit" a cache that no longer exists
+        self.affinity.clear_rank(r.rank)
         r.open_until = now + backoff_delay_s(
             self._reopen_attempt_locked(r), self.cfg.revive_backoff_s,
             self.cfg.revive_backoff_cap_s)
@@ -555,7 +787,17 @@ class ReplicaController:
                 r = self._replicas.get(rank)
                 if r is not None:
                     r.hedges += 1
+                if self.cfg.affinity:
+                    # a hedge deliberately abandons prefix locality —
+                    # latency rescue outranks cache reuse — so it is
+                    # accounted as a miss on the target, keeping the
+                    # hit-rate honest about re-prefill cost
+                    t = self._replicas.get(target)
+                    if t is not None:
+                        t.prefix_misses += 1
             self.metrics.inc("hedged")
+            if self.cfg.affinity:
+                self.metrics.inc("prefix_route_misses")
             telemetry.emit("serve_hedge", slow_replica=rank,
                            target=target, requests=len(items),
                            chunk_age_ms=round(
@@ -646,6 +888,26 @@ class ReplicaController:
                            for r in self._replicas.values())
         return burn, depth / cap, inflight
 
+    def _lane_for_growth_locked(self, now: float) -> str:
+        """Which lane a scale-up replica joins: the ttft-vs-cadence
+        burn split the SloTracker ships per chunk decides.  TTFT
+        violations dominating means prefill is the bottleneck — grow
+        the prefill lane; cadence dominating (or no fresh signal)
+        grows decode.  Only meaningful with lanes enabled."""
+        ttft = cadence = 0.0
+        for r in self._replicas.values():
+            if r.state not in (STATE_OK, STATE_SLOW):
+                continue
+            if now - r.burn_updated > self.cfg.burn_stale_s:
+                continue
+            fam = r.slo_families or {}
+            ttft = max(ttft, float((fam.get("ttft") or {}).get(
+                "violation_fraction") or 0.0))
+            cadence = max(cadence, float(
+                (fam.get("token_cadence") or {}).get(
+                    "violation_fraction") or 0.0))
+        return LANE_PREFILL if ttft > cadence else LANE_DECODE
+
     def autoscale(self, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         burn, occupancy, inflight = self._overload_signals(now)
@@ -672,15 +934,21 @@ class ReplicaController:
                         log.warning("serve scale-up failed: %s", e)
                         return
                     with self._lock:
-                        self._replicas[rank] = ReplicaHealth(
-                            rank, scaled=True)
+                        health = ReplicaHealth(rank, scaled=True)
+                        if self.cfg.prefill_replicas > 0:
+                            health.lane = self._lane_for_growth_locked(
+                                now)
+                        self._replicas[rank] = health
+                        self.affinity.add_rank(rank)
+                        lane = health.lane
                     self.metrics.inc("scale_ups")
                     telemetry.emit("serve_scale_up", replica=rank,
+                                   lane=lane,
                                    burn=round(burn, 3),
                                    occupancy=round(occupancy, 3))
                     log.warning("serve scale-UP: added replica %d "
-                                "(burn %.2f, occupancy %.2f)", rank,
-                                burn, occupancy)
+                                "to %s lane (burn %.2f, occupancy "
+                                "%.2f)", rank, lane, burn, occupancy)
             return
         self._hot_since = None
         # -- scale down (graceful drain) --------------------------------- #
@@ -699,6 +967,16 @@ class ReplicaController:
                     cands = [r for r in serving
                              if r.state in (STATE_OK, STATE_SLOW)
                              and not r.chunks]
+                    if self.cfg.prefill_replicas > 0:
+                        # lanes enabled: never drain a lane to zero —
+                        # an empty lane forces every request cross-lane
+                        # and silently undoes the disaggregation
+                        lane_counts: Dict[str, int] = {}
+                        for r in serving:
+                            lane_counts[r.lane] = lane_counts.get(
+                                r.lane, 0) + 1
+                        cands = [r for r in cands
+                                 if lane_counts.get(r.lane, 0) > 1]
                     if cands:
                         victim = sorted(
                             cands, key=lambda r: (not r.scaled,
@@ -726,6 +1004,7 @@ class ReplicaController:
                             retire, e)
             with self._lock:
                 self._replicas.pop(retire, None)
+                self.affinity.remove_rank(retire)
             self.metrics.inc("scale_downs")
             telemetry.emit("serve_scale_down", replica=retire)
             log.warning("serve scale-DOWN: drained and retired "
@@ -755,6 +1034,24 @@ class ReplicaController:
         with self._lock:
             return {r.rank: r.state for r in self._replicas.values()}
 
+    def lane_gauges(self) -> Dict[str, float]:
+        """Per-lane occupancy gauges ``ServeMetrics`` merges into every
+        snapshot (``bind_lanes``): replica count and in-flight requests
+        per disaggregated lane.  With lanes disabled every replica
+        reports under decode — the gauges stay live, not absent."""
+        with self._lock:
+            out = {"lane_prefill_replicas": 0.0,
+                   "lane_decode_replicas": 0.0,
+                   "lane_prefill_inflight": 0.0,
+                   "lane_decode_inflight": 0.0}
+            for r in self._replicas.values():
+                lane = (r.lane if r.lane in (LANE_PREFILL, LANE_DECODE)
+                        else LANE_DECODE)
+                out[f"lane_{lane}_replicas"] += 1.0
+                out[f"lane_{lane}_inflight"] += float(
+                    r.inflight_requests)
+        return out
+
     def down_ranks(self) -> List[int]:
         """Ranks currently out of rotation (open/half-open circuits) —
         the ``replicas_down`` compatibility view."""
@@ -773,8 +1070,11 @@ class ReplicaController:
                     for r in self._replicas.values()}
             burn = max((r.slo_burn for r in self._replicas.values()),
                        default=0.0)
+            affinity = self.affinity.state()
+            affinity["enabled"] = self.cfg.affinity
         return {
             "replicas": rows,
+            "affinity": affinity,
             "queue_depth": depth,
             "queue_cap": cap,
             "brownout_watermark": max(1, int(self.cfg.brownout_frac
@@ -789,5 +1089,8 @@ class ReplicaController:
                 "scale_up_burn": self.cfg.scale_up_burn,
                 "occupancy_high": self.cfg.occupancy_high,
                 "brownout_frac": self.cfg.brownout_frac,
+                "affinity": self.cfg.affinity,
+                "prefill_replicas": self.cfg.prefill_replicas,
+                "handoff_min_blocks": self.cfg.handoff_min_blocks,
             },
         }

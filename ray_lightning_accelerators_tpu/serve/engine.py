@@ -61,7 +61,6 @@ uses it as the placed-bytes baseline the paged pool is judged against.
 
 from __future__ import annotations
 
-import hashlib
 import itertools
 import threading
 import time
@@ -73,7 +72,8 @@ import numpy as np
 from ..telemetry import recorder as telemetry
 from ..utils.logging import log
 from .batcher import (AdmissionController, ServeCancelled, ServeRequest,
-                      ServeResponse, blocks_for_request)
+                      ServeResponse, blocks_for_request,
+                      chain_prefix_keys)
 from .metrics import ServeMetrics
 
 # live-plane labels for engines sharing one process (telemetry/live.py)
@@ -246,7 +246,8 @@ class ServeEngine:
                  draft_model: Any = None,
                  draft_params: Any = None,
                  spec_k: int = 4,
-                 slo: Any = "env"):
+                 slo: Any = "env",
+                 handoff_wave_bytes: Optional[int] = None):
         import jax
 
         if model.cfg.sliding_window is not None:
@@ -401,6 +402,21 @@ class ServeEngine:
             self._step = jax.jit(step_tokens,
                                  donate_argnums=(1,) if donate else ())
         self.metrics.bind_queue(lambda: self.batcher.depth)
+        # -- KV handoff (disaggregated prefill/decode lanes) ------------ #
+        # An export request's prefilled blocks stay pinned here (with
+        # their object-store wave refs) until the decode side confirms
+        # the copy landed and the driver calls release_handoff — the
+        # exactly-once seam: a decode-replica crash mid-import can
+        # always fall back to the still-resident source blocks.
+        if handoff_wave_bytes is None:
+            from ..analysis import knobs
+            handoff_wave_bytes = knobs.get_int(
+                "RLA_TPU_SERVE_HANDOFF_WAVE_BYTES", 4 << 20)
+        self.handoff_wave_bytes = max(1, int(handoff_wave_bytes))
+        self._handoff_lock = threading.Lock()
+        self._handoffs: Dict[int, Tuple[ServeRequest, List[int],
+                                        List[Any]]] = {}
+        self._handoff_ids = itertools.count()
         self._prefills: Dict[Any, Any] = {}
         self._cache = None          # dense cache OR paged pool
         self._pool_bytes = 0        # measured placed pool bytes (paged)
@@ -467,6 +483,12 @@ class ServeEngine:
         n = self.batcher.shutdown()
         if n:
             self.metrics.inc("cancelled", n)
+        # any export holds never released by the driver (tier teardown
+        # mid-handoff): free their blocks and object-store payloads now
+        with self._handoff_lock:
+            held = list(self._handoffs.keys())
+        for hid in held:
+            self.release_handoff(hid)
         if self._live_label is not None:
             from ..telemetry import live as live_lib
             srv = live_lib.get_server()
@@ -520,8 +542,121 @@ class ServeEngine:
                        prompt_len=int(resp.request.prompt.size))
         return resp
 
+    def submit_handoff(self, prompt: Any, max_new_tokens: int, *,
+                       t_submit: Optional[float] = None,
+                       deadline: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> ServeResponse:
+        """Admit a PREFILL-ONLY request (the disaggregated prefill
+        lane, serve/replicas.py): the engine prefills the prompt into
+        its pool and the response resolves to a KV handoff DESCRIPTOR —
+        a picklable dict a decode-lane engine turns back into a live
+        slot via ``submit_import`` — instead of tokens.  The prefilled
+        blocks stay pinned on this engine until ``release_handoff``.
+        ``t_submit``/``deadline``/``trace_id`` carry the client's
+        ORIGINAL stamps so the hop never resets the SLO clock."""
+        from .batcher import PoolExhausted, QueueFull, RequestRejected
+        if not self.paged:
+            self.metrics.inc("rejected")
+            raise RequestRejected(
+                "KV handoff needs the paged engine (the descriptor is a "
+                "block-table span); pass paged=True")
+        try:
+            resp = self.batcher.submit(prompt, max_new_tokens,
+                                       export_handoff=True,
+                                       t_submit=t_submit,
+                                       deadline=deadline,
+                                       trace_id=trace_id)
+        except PoolExhausted:
+            self.metrics.inc("rejected")
+            self.metrics.inc("pool_exhausted")
+            raise
+        except (QueueFull, RequestRejected):
+            self.metrics.inc("rejected")
+            raise
+        self.metrics.inc("submitted")
+        telemetry.emit("serve_admit", trace=resp.request.trace_id,
+                       request=resp.request.request_id,
+                       prompt_len=int(resp.request.prompt.size),
+                       export_handoff=True)
+        return resp
+
+    def submit_import(self, descriptor: Dict[str, Any]) -> ServeResponse:
+        """Admit a request whose prefill ALREADY HAPPENED on a prefill-
+        lane engine: ``descriptor`` is a ``submit_handoff`` result.  The
+        engine allocates fresh physical blocks, replays the descriptor's
+        object-store waves into them (the block-id remap), and the
+        request starts life mid-decode — the response resolves to
+        prompt + generated tokens exactly like ``submit``.  Bypasses the
+        queue-depth cap (the request was admitted once at the tier) but
+        not the pool check: the blocks are real memory here."""
+        from .batcher import PoolExhausted, QueueFull, RequestRejected
+        if not self.paged:
+            self.metrics.inc("rejected")
+            raise RequestRejected(
+                "KV handoff import needs the paged engine; pass "
+                "paged=True")
+        if int(descriptor.get("block_len", -1)) != self.block_len:
+            self.metrics.inc("rejected")
+            raise RequestRejected(
+                f"handoff block_len {descriptor.get('block_len')} != "
+                f"this engine's block_len {self.block_len}: a block-id "
+                "remap cannot re-tile blocks")
+        try:
+            resp = self.batcher.submit(
+                descriptor["prompt"], int(descriptor["max_new_tokens"]),
+                import_handoff=descriptor,
+                t_submit=descriptor.get("t_submit"),
+                deadline=descriptor.get("deadline"),
+                trace_id=descriptor.get("trace_id"))
+        except PoolExhausted:
+            self.metrics.inc("rejected")
+            self.metrics.inc("pool_exhausted")
+            raise
+        except (QueueFull, RequestRejected):
+            self.metrics.inc("rejected")
+            raise
+        self.metrics.inc("submitted")
+        telemetry.emit("serve_admit", trace=resp.request.trace_id,
+                       request=resp.request.request_id,
+                       prompt_len=int(resp.request.prompt.size),
+                       import_handoff=True)
+        return resp
+
+    def release_handoff(self, handoff_id: int) -> bool:
+        """Drop an export's hold: release its pinned blocks (registered
+        full prompt blocks stay LRU-cached in the prefix index — the
+        source keeps serving prefix hits until eviction reclaims them),
+        return its admission reservation, and delete the object-store
+        wave payloads.  Idempotent; safe from any thread (the allocator,
+        admission controller and object store are each internally
+        locked, and release never touches the device pool)."""
+        with self._handoff_lock:
+            held = self._handoffs.pop(handoff_id, None)
+        if held is None:
+            return False
+        req, blocks, refs = held
+        for b in blocks:
+            self.allocator.release(b)
+        self.batcher.release_blocks(req)
+        from ..runtime import object_store
+        store = object_store.global_store()
+        for ref in refs:
+            try:
+                store.delete(ref)
+            except Exception:
+                pass  # best-effort: a dead owner already unlinked
+        telemetry.emit("serve_kv_release", request=req.request_id,
+                       handoff=handoff_id, blocks=len(blocks))
+        return True
+
     def stats(self) -> Dict[str, Any]:
-        return self.metrics.snapshot()
+        out = self.metrics.snapshot()
+        if self._slo is not None:
+            # the ttft-vs-cadence burn split rides every stats snapshot
+            # so the tier's lane autoscaler reads it for free
+            # (serve/controller.py _lane_for_growth_locked)
+            out["slo_families"] = self._slo.family_rates()
+        return out
 
     # ------------------------------------------------------------------ #
     # Pool gauges (paged)                                                #
@@ -668,20 +803,48 @@ class ServeEngine:
             self._prefills[key] = jax.jit(fn)  # graftlint: ok(retrace) — memoized per bucket
         return self._prefills[key]
 
+    def _kv_gather_fn(self, cap: int):
+        """KV-handoff export gather, one program per wave width: read a
+        fixed-width wave of block ids out of the pool.  The pool is NOT
+        donated (the source keeps serving from it); ids short of ``cap``
+        are padded with the garbage block 0 and sliced off host-side, so
+        every wave of a handoff — and every later handoff with the same
+        wave bound — reuses this one program (zero steady-state
+        recompiles, compile-guard pinned in the tests)."""
+        key = ("kv_gather", cap)
+        if key not in self._prefills:
+            jax, model = self._jax, self.model
+
+            def fn(pool, ids):
+                return model.paged_blocks_gather(pool, ids)
+
+            self._prefills[key] = jax.jit(fn)  # graftlint: ok(retrace) — memoized per wave width
+        return self._prefills[key]
+
+    def _kv_scatter_fn(self, cap: int):
+        """KV-handoff import scatter (the block-id remap made real):
+        write a fixed-width wave of shipped block payloads into freshly
+        allocated local ids.  Pad entries target the garbage block 0.
+        Pool donated where donation is real — the hot-loop reassignment
+        argument from the decode step applies unchanged."""
+        key = ("kv_scatter", cap)
+        if key not in self._prefills:
+            jax, model = self._jax, self.model
+
+            def fn(pool, ids, k, v):
+                return model.paged_blocks_scatter(pool, ids, k, v)
+
+            self._prefills[key] = jax.jit(  # graftlint: ok(retrace) — memoized per wave width
+                fn, donate_argnums=(0,) if self._donate else ())
+        return self._prefills[key]
+
     # -- block bookkeeping ---------------------------------------------- #
     def _prefix_keys(self, prompt: np.ndarray) -> List[str]:
         """Chain hashes of the prompt's FULL blocks: key j commits to
         tokens [0, (j+1)*block_len) — a hit therefore guarantees the
         whole prefix matches, which is what makes the cached k/v exact
         for the new request."""
-        bl = self.block_len
-        n_full = int(prompt.size) // bl
-        keys: List[str] = []
-        h = hashlib.blake2b(digest_size=16)
-        for j in range(n_full):
-            h.update(prompt[j * bl:(j + 1) * bl].tobytes())
-            keys.append(h.hexdigest())
-        return keys
+        return chain_prefix_keys(prompt, self.block_len)
 
     def _release_request(self, req: ServeRequest,
                          blocks: List[int]) -> None:
@@ -782,6 +945,12 @@ class ServeEngine:
             if item is None:
                 break
             req, resp = item
+            if self.paged and req.import_handoff is not None:
+                # decode-lane entry: no prefill, just a block remap
+                if not self._admit_import(i, req, resp):
+                    break  # pool cannot place it now; request pushed back
+                admitted += 1
+                continue
             if self.paged and req.speculative \
                     and self.draft_model is not None \
                     and all(s is None for s in self._slots):
@@ -867,6 +1036,13 @@ class ServeEngine:
         if self.paged:
             first, table, now = self._paged_prefill(req, resp, blocks,
                                                     shared, keys, slot=i)
+            if req.export_handoff:
+                # prefill lane: the request's lifecycle on THIS engine
+                # ends here — ship the blocks, keep them pinned until
+                # the decode side confirms (release_handoff)
+                self._export_handoff(req, resp, blocks, keys, first)
+                self._observe_pool()
+                return
         else:
             t_a = time.monotonic()
             self.metrics.observe_queue_wait(t_a - req.t_submit)
@@ -907,6 +1083,150 @@ class ServeEngine:
             if self.paged:
                 self._tables[i, :] = table
         self._observe_pool()
+
+    # -- KV handoff (disaggregated lanes) -------------------------------- #
+    def _export_handoff(self, req: ServeRequest, resp: ServeResponse,
+                        blocks: List[int], keys: List[str],
+                        first: int) -> None:
+        """Ship a just-prefilled request's KV blocks to the object store
+        in bounded waves and resolve its response with the handoff
+        descriptor.  The blocks stay pinned (refcounted) on this engine
+        until ``release_handoff`` — a decode-side crash mid-import can
+        always re-prefill against the still-cached source."""
+        jnp = self._jax.numpy
+        from ..parallel.redistribute import wave_schedule
+        from ..runtime import object_store
+        s0 = int(req.prompt.size)
+        # per-block payload bytes (k+v), measured from the real pool
+        per_block = max(1, self._pool_bytes // self.n_blocks)
+        waves = wave_schedule([per_block] * len(blocks),
+                              self.handoff_wave_bytes)
+        cap = max(len(w) for w in waves)
+        gather = self._kv_gather_fn(cap)
+        store = object_store.global_store()
+        refs: List[Any] = []
+        wave_out: List[Tuple[int, Any]] = []
+        total_bytes = 0
+        try:
+            for w in waves:
+                ids = np.zeros((cap,), np.int32)  # pad = garbage block 0
+                ids[:len(w)] = [blocks[j] for j in w]
+                k, v = gather(self._cache, jnp.asarray(ids))
+                # graftlint: ok(host-sync) — the copy IS the handoff
+                kk = np.asarray(k)[:, :len(w)]
+                vv = np.asarray(v)[:, :len(w)]  # graftlint: ok(host-sync) — the copy IS the handoff
+                ref = store.put({"k": kk, "v": vv})
+                refs.append(ref)
+                wave_out.append((len(w), ref))
+                total_bytes += kk.nbytes + vv.nbytes
+        except BaseException:
+            for ref in refs:  # don't leak shm segments on a failed ship
+                try:
+                    store.delete(ref)
+                except Exception:
+                    pass
+            raise
+        hid = next(self._handoff_ids)
+        desc = {
+            "handoff_id": hid,
+            "request_id": req.request_id,
+            "prompt": req.prompt,
+            "max_new_tokens": req.max_new_tokens,
+            "first": first,
+            "pos": s0,
+            "keys": list(keys),
+            "block_len": self.block_len,
+            "wave_cap": cap,
+            "waves": wave_out,
+            "bytes": total_bytes,
+            "t_submit": req.t_submit,
+            "deadline": req.deadline,
+            "trace_id": req.trace_id,
+        }
+        with self._handoff_lock:
+            self._handoffs[hid] = (req, list(blocks), refs)
+        self.metrics.inc("kv_handoffs")
+        self.metrics.inc("kv_handoff_bytes", total_bytes)
+        telemetry.emit("serve_kv_export", trace=req.trace_id,
+                       request=req.request_id, handoff=hid,
+                       blocks=len(blocks), waves=len(wave_out),
+                       bytes=total_bytes)
+        if resp._complete(desc):
+            self.metrics.inc("completed")
+
+    def _admit_import(self, i: int, req: ServeRequest,
+                      resp: ServeResponse) -> bool:
+        """Turn a handoff descriptor into a live decode slot: allocate
+        this engine's own blocks (the remap — no prefix lookup, the
+        shipped bytes ARE the prefix), replay the object-store waves
+        into them, register the full prompt blocks under their chain
+        keys (first-writer-wins), and join mid-decode.  Returns False
+        when the pool cannot place it right now (request pushed back).
+        A stale-ref failure (source died and unlinked its segments)
+        fails THIS response typed without killing the loop — the driver
+        requeues the original for a full re-prefill."""
+        jnp = self._jax.numpy
+        from ..runtime import object_store
+        desc = req.import_handoff
+        needed = req.blocks_reserved or blocks_for_request(
+            int(req.prompt.size), req.max_new_tokens, self.block_len)
+        blocks = self.allocator.alloc(needed)
+        if blocks is None:
+            self.batcher.push_front((req, resp))
+            return False
+        try:
+            cap = int(desc["wave_cap"])
+            scatter = self._kv_scatter_fn(cap)
+            store = object_store.global_store()
+            idx = 0
+            for count, ref in desc["waves"]:
+                payload = store.get(ref)
+                ids = np.zeros((cap,), np.int32)  # pad = garbage block 0
+                ids[:count] = blocks[idx:idx + count]
+                idx += count
+                kk, vv = payload["k"], payload["v"]
+                if count < cap:
+                    pad = [(0, 0)] * kk.ndim
+                    pad[1] = (0, cap - count)
+                    kk = np.pad(kk, pad)  # pad payloads land in block 0
+                    vv = np.pad(vv, pad)
+                self._cache = scatter(self._cache, jnp.asarray(ids),
+                                      jnp.asarray(kk), jnp.asarray(vv))
+        except object_store.ObjectStoreError as e:
+            self._release_request(req, blocks)
+            if resp._fail(e):
+                self.metrics.inc("failed")
+            return True  # consumed; the loop (and the tier) live on
+        except BaseException as e:
+            self._release_request(req, blocks)
+            if resp._fail(e):
+                self.metrics.inc("failed")
+            raise
+        # register only AFTER every wave landed: a partially imported
+        # block must never be reachable from the prefix index
+        if self.prefix_cache:
+            for j, key in enumerate(desc.get("keys", ())):
+                self.allocator.register(key, blocks[j])
+        first = int(desc["first"])
+        now = time.monotonic()
+        # no TTFT/queue-wait observation here: the first token was timed
+        # where it was produced (the prefill lane); this engine only
+        # contributes decode cadence
+        telemetry.emit("serve_kv_import", trace=req.trace_id,
+                       request=req.request_id,
+                       handoff=desc.get("handoff_id"),
+                       blocks=len(blocks), waves=len(desc["waves"]))
+        if req.max_new_tokens == 1:
+            self._finish(req, resp, [first])
+            self._release_request(req, blocks)
+        else:
+            self._slots[i] = _Slot(req, resp, pos=int(desc["pos"]),
+                                   first_token=first, t_now=now,
+                                   blocks=blocks)
+            self._tables[i, :] = 0
+            self._tables[i, :len(blocks)] = blocks
+        self._observe_pool()
+        return True
 
     # -- decode --------------------------------------------------------- #
     def _decode_step(self, active: List[int]) -> None:

@@ -59,7 +59,17 @@ class ServeMetrics:
                  # revived = circuit-breaker replica revivals;
                  # scale_ups/scale_downs = autoscale replica count moves
                  "hedged", "hedge_wins", "brownout_shed", "revived",
-                 "scale_ups", "scale_downs")
+                 "scale_ups", "scale_downs",
+                 # prefix-affinity routing + disaggregated lanes
+                 # (serve/controller.py, serve/replicas.py):
+                 # prefix_route_hits/misses = tier route decisions that
+                 # did/didn't land a request on a replica with its
+                 # prefix run resident (hedges count as misses);
+                 # kv_handoffs = prefill->decode block handoffs
+                 # completed; kv_handoff_bytes = KV bytes those
+                 # handoffs shipped through the object store
+                 "prefix_route_hits", "prefix_route_misses",
+                 "kv_handoffs", "kv_handoff_bytes")
 
     # pool/HBM fields are GAUGES (live values, not monotone counters);
     # telemetry/registry.py keys its Prometheus type choice off this set
@@ -74,6 +84,12 @@ class ServeMetrics:
     # burn rate is a live level an autoscaler reads, never a counter
     SLO_GAUGES = ("slo_burn_rate", "slo_window_observations")
 
+    # disaggregated-lane occupancy (serve/controller.py lane_gauges):
+    # live per-lane replica counts and in-flight requests — levels,
+    # not tallies, so the registry must type them gauge
+    LANE_GAUGES = ("lane_prefill_replicas", "lane_decode_replicas",
+                   "lane_prefill_inflight", "lane_decode_inflight")
+
     def __init__(self, profiler: Optional[Profiler] = None):
         self.profiler = profiler or Profiler()
         self._lock = threading.Lock()
@@ -86,6 +102,7 @@ class ServeMetrics:
         self._queue_depth: Callable[[], int] = lambda: 0
         self._pool_gauges: Optional[Callable[[], Dict[str, Any]]] = None
         self._slo_gauges: Optional[Callable[[], Dict[str, Any]]] = None
+        self._lane_gauges: Optional[Callable[[], Dict[str, Any]]] = None
 
     # ------------------------------------------------------------------ #
     def bind_queue(self, depth_fn: Callable[[], int]) -> None:
@@ -106,6 +123,13 @@ class ServeMetrics:
         into every snapshot.  Engines without an SLO policy never bind,
         and the fields stay absent."""
         self._slo_gauges = gauges_fn
+
+    def bind_lanes(self, gauges_fn: Callable[[], Dict[str, Any]]) -> None:
+        """Wire the live per-lane occupancy gauges
+        (serve/controller.py ``ReplicaController.lane_gauges``).
+        Merged outside the metrics lock like every bound gauge source,
+        so the controller's own lock never nests inside this one."""
+        self._lane_gauges = gauges_fn
 
     def observe_pool(self, used_blocks: int, concurrent: int) -> None:
         """Record a pool-occupancy observation (engine calls at every
@@ -224,6 +248,8 @@ class ServeMetrics:
             out["peak_concurrent"] = peak_conc
         if self._slo_gauges is not None:
             out.update(self._slo_gauges())
+        if self._lane_gauges is not None:
+            out.update(self._lane_gauges())
         out["throughput_tok_s"] = (
             counters["tokens_generated"] / busy_s if busy_s > 0 else 0.0)
         out["ttft_s"] = pct(self.TTFT)

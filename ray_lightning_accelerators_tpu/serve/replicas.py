@@ -40,17 +40,25 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..runtime.actors import ActorPool, RemoteError
+from ..runtime.object_store import ObjectStoreError
 from ..runtime.watchdog import WorkerWedged
 from ..utils.logging import log
 from .batcher import (AdmissionController, BrownoutShed, ServeCancelled,
-                      ServeRequest, ServeResponse)
-from .controller import ControllerConfig, ReplicaController
+                      ServeRequest, ServeResponse, chain_prefix_keys)
+from .controller import (LANE_DECODE, LANE_PREFILL, ControllerConfig,
+                         ReplicaController)
 from .metrics import ServeMetrics
+
+# affinity routing hashes at most this many chain keys per prompt: the
+# router only needs enough of the chain to discriminate prefixes, not a
+# digest of the whole prompt
+_AFFINITY_KEY_LIMIT = 32
 
 # live-plane labels for groups sharing one process (telemetry/live.py)
 _GROUP_SEQ = itertools.count()
@@ -129,6 +137,60 @@ def _replica_serve(rank: int, items: List[Tuple[int, Any, int]]
     return results, _engine_stats_snapshot()
 
 
+def _replica_prefill(rank: int,
+                     items: List[Tuple[int, Any, int, float, Any, Any]]
+                     ) -> Tuple[List[Tuple[int, Any]], Dict[str, Any]]:
+    """Prefill-lane chunk (runs IN the worker): each request resolves to
+    a KV handoff DESCRIPTOR, not tokens — the engine pins the prefilled
+    blocks until the driver confirms the decode side took ownership
+    (``_replica_release``).  Items carry the client's original
+    ``t_submit``/``deadline``/``trace_id`` stamps; monotonic clocks are
+    system-wide on this host, so the absolute deadline survives the
+    process hop and an expired request still sheds typed at the lane."""
+    if _ENGINE is None:
+        raise RuntimeError("replica engine not initialized")
+    chaos = _replica_chaos(rank)
+    if chaos is not None:
+        chaos.on_dispatch()  # may crash/hang/slow THIS chunk
+    handles = [(rid, _ENGINE.submit_handoff(
+        np.asarray(prompt, np.int32), n, t_submit=t_submit,
+        deadline=deadline, trace_id=trace_id))
+        for rid, prompt, n, t_submit, deadline, trace_id in items]
+    results = [(rid, h.result()) for rid, h in handles]
+    return results, _engine_stats_snapshot()
+
+
+def _replica_import(rank: int, descs: List[Tuple[int, Dict[str, Any]]]
+                    ) -> Tuple[List[Tuple[int, Any]], Dict[str, Any]]:
+    """Decode-lane chunk (runs IN the worker): turn each handoff
+    descriptor into a live mid-decode slot and wait out the generation.
+    A stale object-store ref (the source died and its segments were
+    unlinked) surfaces typed — the driver requeues the originals for a
+    full re-prefill instead of failing them."""
+    if _ENGINE is None:
+        raise RuntimeError("replica engine not initialized")
+    chaos = _replica_chaos(rank)
+    if chaos is not None:
+        chaos.on_dispatch()  # may crash/hang/slow THIS chunk
+    handles = [(rid, _ENGINE.submit_import(desc)) for rid, desc in descs]
+    results = [(rid, np.asarray(h.result())) for rid, h in handles]
+    return results, _engine_stats_snapshot()
+
+
+def _replica_release(rank: int, handoff_ids: List[int]) -> int:
+    """Drop export holds on this prefill replica (runs IN the worker):
+    the decode side owns the KV now — the source's copies stay only as
+    LRU-evictable prefix cache.  Deliberately NOT a chaos dispatch:
+    release is cleanup bookkeeping, and letting it consume chaos
+    dispatch numbers would make crash-at-chunk-N scripts misfire."""
+    if _ENGINE is None:
+        return 0
+    n = 0
+    for hid in handoff_ids:
+        n += bool(_ENGINE.release_handoff(hid))
+    return n
+
+
 def _replica_stats() -> Dict[str, Any]:
     """Engine metrics snapshot (runs IN the worker) — also the circuit
     breaker's half-open probe dispatch."""
@@ -196,7 +258,8 @@ class ServeReplicas:
                  env_per_worker: Optional[List[Dict[str, str]]] = None,
                  idle_poll_s: float = 0.02,
                  controller: Optional[ControllerConfig] = None,
-                 scale_env: Optional[Dict[str, str]] = None):
+                 scale_env: Optional[Dict[str, str]] = None,
+                 affinity_block_len: int = 16):
         envs = [dict(e) for e in (env_per_worker
                                   or [{} for _ in range(num_replicas)])]
         if heartbeat_s is not None:
@@ -205,6 +268,14 @@ class ServeReplicas:
                              str(heartbeat_s))
         self.chunk_size = max(1, chunk_size)
         self.queue_depth = queue_depth
+        # affinity + lane routing hash prompts block-wise DRIVER-side;
+        # this MUST equal the engines' block_len or the router's chain
+        # keys never match what the replicas' prefix indexes register
+        self.affinity_block_len = max(1, affinity_block_len)
+        # handoff descriptors awaiting a decode-lane dispatch, appended
+        # by prefill-done callbacks (collector threads) and drained by
+        # the dispatch loop; deque append/popleft are atomic
+        self._pending_imports: deque = deque()
         self.metrics = ServeMetrics()
         self.batcher = AdmissionController(queue_depth=queue_depth,
                                            max_total_len=max_total_len)
@@ -230,6 +301,10 @@ class ServeReplicas:
         self.controller = ReplicaController(self, cfg)
         self.max_requeues = (max_requeues if max_requeues is not None
                              else cfg.max_retries)
+        # per-lane occupancy gauges ride every tier snapshot; the merge
+        # happens outside the metrics lock (ServeMetrics.snapshot), so
+        # taking the controller lock inside lane_gauges cannot invert
+        self.metrics.bind_lanes(self.controller.lane_gauges)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="rla-tpu-serve-dispatch")
@@ -305,6 +380,15 @@ class ServeReplicas:
         n = self.batcher.shutdown()
         if n:
             self.metrics.inc("cancelled", n)
+        # handoffs prefixed but never imported: cancel typed (their
+        # source holds die with the replica engines at pool shutdown)
+        while self._pending_imports:
+            _src, req, resp, _desc = self._pending_imports.popleft()
+            if resp._fail(ServeCancelled(
+                    f"request {req.request_id} cancelled: tier shut "
+                    "down with its KV handoff awaiting a decode "
+                    "replica")):
+                self.metrics.inc("cancelled")
         if self.watchdog is not None:
             self.watchdog.stop()
         if self._live_label is not None:
@@ -391,6 +475,9 @@ class ServeReplicas:
                 time.sleep(self._idle_poll_s)
 
     def _dispatch_once(self) -> None:
+        # handoff descriptors first: their prefill already happened, so
+        # every tick they wait is pure added TTFB on a finished prefill
+        self._dispatch_imports()
         if not self.batcher.wait_for_work(self._idle_poll_s):
             return
         if not self.controller.serving_possible():
@@ -402,14 +489,16 @@ class ServeReplicas:
                         f"request {req.request_id}: every replica is "
                         "down and auto-revive is disabled")):
                     self.metrics.inc("failed")
+            while self._pending_imports:
+                _src, req, resp, _desc = self._pending_imports.popleft()
+                if resp._fail(ServeCancelled(
+                        f"request {req.request_id}: every replica is "
+                        "down and auto-revive is disabled")):
+                    self.metrics.inc("failed")
             time.sleep(self._idle_poll_s)
             return
-        rank = self.controller.route()
-        if rank is None:
-            time.sleep(self._idle_poll_s)
-            return
-        chunk: List[Tuple[ServeRequest, ServeResponse]] = []
-        while len(chunk) < self.chunk_size:
+        batch: List[Tuple[ServeRequest, ServeResponse]] = []
+        while len(batch) < self.chunk_size:
             item = self.batcher.pop()
             if item is None:
                 break
@@ -418,13 +507,45 @@ class ServeReplicas:
                 # nothing left to serve — dropping it here saves a
                 # whole wasted prefill+decode on a replica
                 continue
-            chunk.append(item)
-        if not chunk:
+            batch.append(item)
+        if not batch:
             # nothing dispatchable right now (empty queue race or a
             # requeue-lane head still inside its retry backoff)
             time.sleep(self._idle_poll_s / 2)
             return
-        self._dispatch(rank, chunk)
+        # route PER REQUEST (prefix affinity is a property of the
+        # prompt, not the chunk), then regroup by destination so one
+        # dispatch still carries everything the replica can batch
+        cfg = self.controller.cfg
+        lanes_on = cfg.prefill_replicas > 0
+        bl = self.affinity_block_len
+        groups: Dict[Tuple[int, bool], List[
+            Tuple[ServeRequest, ServeResponse]]] = {}
+        unrouted: List[Tuple[ServeRequest, ServeResponse]] = []
+        for req, resp in batch:
+            keys = (chain_prefix_keys(req.prompt, bl,
+                                      limit=_AFFINITY_KEY_LIMIT)
+                    if cfg.affinity else None) or None
+            handoff = (lanes_on and req.max_new_tokens > 1
+                       and int(req.prompt.size) // bl
+                       >= cfg.handoff_min_blocks)
+            lane = ((LANE_PREFILL if handoff else LANE_DECODE)
+                    if lanes_on else None)
+            rank = self.controller.route(prefix_keys=keys, lane=lane)
+            if rank is None:
+                unrouted.append((req, resp))
+                continue
+            groups.setdefault((rank, handoff), []).append((req, resp))
+        for item in reversed(unrouted):  # keep FIFO order at the head
+            self.batcher.push_front(item)
+        if not groups:
+            time.sleep(self._idle_poll_s)
+            return
+        for (rank, handoff), chunk in groups.items():
+            if handoff:
+                self._dispatch_prefill(rank, chunk)
+            else:
+                self._dispatch(rank, chunk)
 
     def _dispatch(self, rank: int,
                   chunk: List[Tuple[ServeRequest, ServeResponse]],
@@ -519,3 +640,224 @@ class ServeReplicas:
         delay = self.controller.charge_retry(rank, req)
         if self.batcher.requeue(req, resp, delay_s=delay):
             self.metrics.inc("requeued")
+
+    # ------------------------------------------------------------------ #
+    # Disaggregated prefill/decode lanes (KV handoff)                    #
+    # ------------------------------------------------------------------ #
+    def _dispatch_prefill(self, rank: int,
+                          chunk: List[Tuple[ServeRequest,
+                                            ServeResponse]]) -> None:
+        """Ship one prefill-lane chunk: the replica prefills and returns
+        handoff DESCRIPTORS; `_on_prefill_done` queues them for a
+        decode-lane import.  The chunk stays a first-class controller
+        chunk — hedging sees it age like any other, and a hedge fires
+        the normal full-serve path (first-completion-wins keeps that
+        race exactly-once)."""
+        chunk_id = self.controller.on_dispatch(rank, chunk)
+        items = [(req.request_id, req.prompt, req.max_new_tokens,
+                  req.t_submit, req.deadline, req.trace_id)
+                 for req, _ in chunk]
+        w = self._worker(rank)
+        if w is None:
+            exc = RuntimeError(f"replica {rank} left the pool before "
+                               "prefill dispatch")
+            self.controller.note_infra_failure(rank, chunk_id, exc)
+            for req, resp in chunk:
+                self._requeue_or_fail(req, resp, exc, rank)
+            return
+        fut = w.execute(_replica_prefill, rank, items)
+        fut.add_done_callback(
+            lambda f, r=rank, cid=chunk_id, c=chunk:
+            self._on_prefill_done(r, cid, c, f))
+
+    def _on_prefill_done(self, rank: int, chunk_id: int,
+                         chunk: List[Tuple[ServeRequest, ServeResponse]],
+                         fut) -> None:
+        """Collector-thread callback for a prefill-lane chunk: hand each
+        descriptor to the import queue (or clean up after a hedge that
+        answered first)."""
+        exc = fut.exception()
+        if exc is not None:
+            if _is_application_failure(exc):
+                self.controller.note_app_failure(rank, chunk_id)
+                log.error("replica %d failed a prefill chunk "
+                          "application-side: %s", rank, exc)
+                for req, resp in chunk:
+                    if resp._fail(exc):
+                        self.metrics.inc("failed")
+                return
+            self.controller.note_infra_failure(rank, chunk_id, exc)
+            if isinstance(exc, WorkerWedged):
+                self.metrics.inc("wedge_events")
+            log.warning("prefill replica %d lost mid-chunk (%s); "
+                        "recovering %d request(s)", rank,
+                        type(exc).__name__, len(chunk))
+            for req, resp in chunk:
+                self._requeue_or_fail(req, resp, exc, rank)
+            return
+        results, stats = fut.result()
+        self.controller.note_success(rank, chunk_id, stats)
+        results = dict(results)
+        now = time.monotonic()
+        queued = False
+        for req, resp in chunk:
+            desc = results.get(req.request_id)
+            if desc is None:
+                self._requeue_or_fail(req, resp, RuntimeError(
+                    f"replica {rank} returned no handoff for request "
+                    f"{req.request_id}"), rank)
+                continue
+            if resp.done():
+                # a hedge (full serve) answered while the lane worked:
+                # nothing to import, just drop the source hold
+                self._release_source(rank, [desc["handoff_id"]])
+                continue
+            self.metrics.inc("kv_handoffs")
+            self.metrics.inc("kv_handoff_bytes",
+                             int(desc.get("bytes", 0)))
+            # tier-level TTFT: the first token exists the moment the
+            # prefill lane returns, not when decode finishes
+            if resp.ttft_s is None:
+                resp.ttft_s = now - req.t_submit
+                self.metrics.observe_ttft(resp.ttft_s)
+            self._pending_imports.append((rank, req, resp, desc))
+            queued = True
+        if queued:
+            self.batcher.kick()  # wake the dispatcher for the imports
+
+    def _dispatch_imports(self) -> None:
+        """Drain queued handoff descriptors onto decode-lane replicas
+        (runs at the top of every dispatch iteration)."""
+        batch = []
+        while self._pending_imports and len(batch) < self.chunk_size:
+            batch.append(self._pending_imports.popleft())
+        if not batch:
+            return
+        groups: Dict[int, List[Tuple[int, ServeRequest, ServeResponse,
+                                     Dict[str, Any]]]] = {}
+        back = []
+        for entry in batch:
+            src_rank, req, resp, desc = entry
+            if resp.done():
+                # hedge/requeue answered while the descriptor queued
+                self._release_source(src_rank, [desc["handoff_id"]])
+                continue
+            rank = self.controller.route(lane=LANE_DECODE)
+            if rank is None:
+                back.append(entry)
+                continue
+            groups.setdefault(rank, []).append(entry)
+        for entry in reversed(back):
+            self._pending_imports.appendleft(entry)
+        for rank, entries in groups.items():
+            self._dispatch_import(rank, entries)
+
+    def _dispatch_import(self, rank: int,
+                         entries: List[Tuple[int, ServeRequest,
+                                             ServeResponse,
+                                             Dict[str, Any]]]) -> None:
+        chunk = [(req, resp) for _src, req, resp, _d in entries]
+        chunk_id = self.controller.on_dispatch(rank, chunk)
+        descs = [(req.request_id, desc)
+                 for _src, req, _resp, desc in entries]
+        w = self._worker(rank)
+        if w is None:
+            exc = RuntimeError(f"replica {rank} left the pool before "
+                               "import dispatch")
+            self.controller.note_infra_failure(rank, chunk_id, exc)
+            self._recover_import_entries(entries, exc, rank)
+            return
+        fut = w.execute(_replica_import, rank, descs)
+        fut.add_done_callback(
+            lambda f, r=rank, cid=chunk_id, e=entries:
+            self._on_import_done(r, cid, e, f))
+
+    def _on_import_done(self, rank: int, chunk_id: int,
+                        entries: List[Tuple[int, ServeRequest,
+                                            ServeResponse,
+                                            Dict[str, Any]]],
+                        fut) -> None:
+        """Settle a decode-lane import chunk.  Every terminal path
+        releases the source holds exactly once: a released source keeps
+        the prompt blocks LRU-cached in its prefix index, so even the
+        requeue-for-re-prefill path lands back on a warm cache."""
+        exc = fut.exception()
+        if exc is None:
+            results, stats = fut.result()
+            self.controller.note_success(rank, chunk_id, stats)
+            results = dict(results)
+            for _src, req, resp, desc in entries:
+                tokens = results.get(req.request_id)
+                if tokens is None:
+                    self._requeue_or_fail(req, resp, RuntimeError(
+                        f"replica {rank} returned no result for "
+                        f"imported request {req.request_id}"), rank)
+                elif resp._complete(tokens):
+                    self.metrics.inc("completed")
+                    # residency truth: the KV now lives on the decode
+                    # replica — future same-prefix routes go there
+                    self.controller.note_import(rank,
+                                                desc.get("keys"))
+            self._release_entries(entries)
+            return
+        if _is_application_failure(exc):
+            self.controller.note_app_failure(rank, chunk_id)
+            if isinstance(exc, ObjectStoreError):
+                # the shipped payload is gone (source died and its
+                # segments were unlinked): deterministic for THIS
+                # descriptor but not for the request — requeue it for
+                # a full re-prefill instead of failing typed
+                log.warning("import on replica %d hit a stale handoff "
+                            "payload (%s); re-queueing %d request(s) "
+                            "for full re-prefill", rank, exc,
+                            len(entries))
+                for _src, req, resp, _d in entries:
+                    self._requeue_or_fail(req, resp, exc, rank)
+            else:
+                log.error("replica %d failed an import chunk "
+                          "application-side: %s", rank, exc)
+                for _src, req, resp, _d in entries:
+                    if resp._fail(exc):
+                        self.metrics.inc("failed")
+            self._release_entries(entries)
+            return
+        self.controller.note_infra_failure(rank, chunk_id, exc)
+        if isinstance(exc, WorkerWedged):
+            self.metrics.inc("wedge_events")
+        log.warning("decode replica %d lost mid-import (%s); "
+                    "recovering %d request(s)", rank,
+                    type(exc).__name__, len(entries))
+        self._recover_import_entries(entries, exc, rank)
+
+    def _recover_import_entries(self, entries, exc,
+                                rank: Optional[int]) -> None:
+        """Requeue an import chunk's originals (full re-prefill on a
+        survivor) and release their source holds — the sources' prefix
+        caches make the retry's prefill a block-table hit, not a
+        recompute."""
+        for _src, req, resp, _d in entries:
+            self._requeue_or_fail(req, resp, exc, rank)
+        self._release_entries(entries)
+
+    def _release_entries(self, entries) -> None:
+        by_src: Dict[int, List[int]] = {}
+        for src, _req, _resp, desc in entries:
+            by_src.setdefault(src, []).append(desc["handoff_id"])
+        for src, hids in by_src.items():
+            self._release_source(src, hids)
+
+    def _release_source(self, src_rank: int,
+                        handoff_ids: List[int]) -> None:
+        """Fire-and-forget release of export holds on the prefill
+        replica.  Best-effort by design: if the source is gone, its
+        engine (and shm segments) died with it — there is nothing left
+        to release."""
+        w = self._worker(src_rank)
+        if w is None or not w.is_alive:
+            return
+        try:
+            fut = w.execute(_replica_release, src_rank,
+                            list(handoff_ids))
+            fut.add_done_callback(lambda f: f.exception())  # swallow
+        except Exception:
+            pass

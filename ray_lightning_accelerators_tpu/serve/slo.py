@@ -247,6 +247,15 @@ class SloTracker:
                        rates[family]["violation_fraction"] / allowed)
         return round(burn, 6)
 
+    def family_rates(self) -> Dict[str, Dict[str, Any]]:
+        """Per-family windowed rates (observations, violations,
+        violation_fraction) — the ttft-vs-cadence burn SPLIT the
+        disaggregated-lane autoscaler sizes its two lanes off
+        (serve/controller.py ``_lane_for_growth_locked``).  Ships with
+        every chunk's stats snapshot, so the driver reads it without
+        extra dispatches."""
+        return self._family_rates()
+
     def burn_rate(self) -> float:
         """Observed violation fraction over the allowed fraction
         (``1 - target_fraction``), maxed across enabled families.

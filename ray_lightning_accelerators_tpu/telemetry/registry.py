@@ -262,7 +262,7 @@ class MetricsRegistry:
         for key in serve_keys:
             gauge = key in ("queue_depth", "busy_s", "throughput_tok_s",
                             "max_batch") or key in _SM.POOL_GAUGES \
-                or key in _SM.SLO_GAUGES
+                or key in _SM.SLO_GAUGES or key in _SM.LANE_GAUGES
             name = f"rla_tpu_serve_{_prom_name(key)}"
             if not gauge:
                 name = f"{name}_total"
@@ -306,7 +306,10 @@ class MetricsRegistry:
                               ("app_failures", "counter"),
                               ("retries", "counter"),
                               ("hedges", "counter"),
-                              ("revivals", "counter")):
+                              ("revivals", "counter"),
+                              ("prefix_hits", "counter"),
+                              ("prefix_misses", "counter"),
+                              ("prefix_hit_rate", "gauge")):
                 name = f"rla_tpu_serve_replica_{_prom_name(key)}"
                 if kind == "counter":
                     name += "_total"
@@ -322,6 +325,15 @@ class MetricsRegistry:
                     add("rla_tpu_serve_replica_state", 1,
                         f'{{replica="{label}",'
                         f'state="{_prom_name(state)}"}}',
+                        mtype="gauge")
+            # lane one-hot (disaggregated prefill/decode lanes): same
+            # label-pair pattern as state, its own contiguous family
+            for label, row in replicas:
+                lane = row.get("lane")
+                if lane:
+                    add("rla_tpu_serve_replica_lane", 1,
+                        f'{{replica="{label}",'
+                        f'lane="{_prom_name(lane)}"}}',
                         mtype="gauge")
             for key in ("queue_depth", "queue_cap",
                         "brownout_watermark", "max_burn"):

@@ -159,8 +159,9 @@ def render(status: dict) -> str:
     return "\n".join(lines)
 
 
-REPLICA_COLS = ("replica", "state", "in-flight", "dispatched", "retries",
-                "hedges", "revived", "p99ms", "burn", "detail")
+REPLICA_COLS = ("replica", "state", "lane", "in-flight", "dispatched",
+                "retries", "hedges", "revived", "p99ms", "burn",
+                "pfx-hit", "detail")
 
 
 def replica_table(rc: dict):
@@ -174,6 +175,14 @@ def replica_table(rc: dict):
         f"burn {_num(rc.get('max_burn'), '{:.2f}')}, "
         f"replicas {len(rc.get('replicas') or {})}"
         + (f"/{rc['max_replicas']}" if rc.get("max_replicas") else "")]
+    aff = rc.get("affinity") or {}
+    if aff.get("enabled"):
+        res = aff.get("residency") or {}
+        lines.append(
+            f"affinity ring: vnodes {aff.get('vnodes', '-')}, "
+            "resident keys "
+            + (", ".join(f"{k}:{v}" for k, v in sorted(res.items()))
+               or "-"))
     rows = []
     for label in sorted((rc.get("replicas") or {}),
                         key=lambda x: (len(x), x)):
@@ -181,6 +190,7 @@ def replica_table(rc: dict):
         rows.append((
             str(label),
             str(r.get("state", "?")),
+            str(r.get("lane", "-")),
             f"{r.get('inflight_requests', 0)}r/"
             f"{r.get('inflight_chunks', 0)}c",
             str(r.get("dispatched_chunks", "-")),
@@ -189,6 +199,7 @@ def replica_table(rc: dict):
             str(r.get("revivals", "-")),
             _num(r.get("p99_step_ms")),
             _num(r.get("slo_burn"), "{:.2f}"),
+            _num(r.get("prefix_hit_rate"), "{:.2f}"),
             (r.get("detail") or "")[:32],
         ))
     if rows:

@@ -79,7 +79,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     accelerator bench ever ran -- and the CPU-mesh fallback benches
     (gradexchange/input_pipeline/fsdp_exchange/paged_serve/
     mfu_overlap/perf_observatory/live_plane/serve_resilience/resize/
-    pipeline) still land REAL metric lines next
+    pipeline/prefix_affinity) still land REAL metric lines next
     to the death record, so the window exits 0 and the driver records
     numbers (all five earlier BENCH rounds were rc=2 with zero real
     numbers; this pins the fix).  The fallbacks are faked here (the
@@ -134,13 +134,17 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_pipeline",
         lambda: {"metric": "pipeline_bubble_accuracy",
                  "value": 0.96, "unit": "frac", "vs_baseline": 1.2})
+    monkeypatch.setattr(
+        bench, "bench_prefix_affinity",
+        lambda: {"metric": "prefix_affinity_ttft_ratio",
+                 "value": 3.1, "unit": "ratio", "vs_baseline": 3.1})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 11
+    assert len(lines) == 12
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
@@ -153,6 +157,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert lines[8]["metric"] == "serve_resilience_completed_fraction"
     assert lines[9]["metric"] == "resize_inmem_vs_ckpt_downtime_ratio"
     assert lines[10]["metric"] == "pipeline_bubble_accuracy"
+    assert lines[11]["metric"] == "prefix_affinity_ttft_ratio"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -172,7 +177,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         "live_plane_scrape_validity",
         "serve_resilience_completed_fraction",
         "resize_inmem_vs_ckpt_downtime_ratio",
-        "pipeline_bubble_accuracy"]
+        "pipeline_bubble_accuracy",
+        "prefix_affinity_ttft_ratio"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -193,6 +199,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_resize",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_pipeline",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_prefix_affinity",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -257,6 +265,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_pipeline",
         lambda: {"metric": "pipeline_bubble_accuracy",
                  "value": 0.96, "unit": "frac", "vs_baseline": 1.2})
+    monkeypatch.setattr(
+        bench, "bench_prefix_affinity",
+        lambda: {"metric": "prefix_affinity_ttft_ratio",
+                 "value": 3.1, "unit": "ratio", "vs_baseline": 3.1})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -276,7 +288,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "live_plane_scrape_validity",
         "serve_resilience_completed_fraction",
         "resize_inmem_vs_ckpt_downtime_ratio",
-        "pipeline_bubble_accuracy"]
+        "pipeline_bubble_accuracy",
+        "prefix_affinity_ttft_ratio"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -397,6 +410,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_resize",
         lambda: {"metric": "resize_inmem_vs_ckpt_downtime_ratio",
                  "value": 3.7, "unit": "x", "vs_baseline": 1.16})
+    monkeypatch.setattr(
+        bench, "bench_prefix_affinity",
+        lambda: {"metric": "prefix_affinity_ttft_ratio",
+                 "value": 3.1, "unit": "ratio", "vs_baseline": 3.1})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -415,6 +432,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "live_plane_scrape_validity" in metrics
     assert "serve_resilience_completed_fraction" in metrics
     assert "resize_inmem_vs_ckpt_downtime_ratio" in metrics
+    assert "prefix_affinity_ttft_ratio" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

@@ -1,0 +1,611 @@
+"""Prefix-affinity routing + disaggregated prefill/decode lanes
+(serve/controller.py, serve/engine.py, serve/replicas.py): the
+consistent-hash ring with per-replica prefix residency, affinity-aware
+routing that health always overrides, the KV block handoff between a
+prefill-lane and a decode-lane engine (block-table remap + wave-bounded
+object-store copy), and the lane/prefix observability surfaces.  All
+CPU; the routing units run on a fake group (no subprocesses), the
+handoff end-to-end on in-process engines, the crash-during-handoff
+loop on a real replica pool."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_accelerators_tpu.serve import (ControllerConfig,
+                                                  DeadlineExceeded,
+                                                  ReplicaController,
+                                                  ServeEngine,
+                                                  ServeMetrics,
+                                                  SloPolicy)
+from ray_lightning_accelerators_tpu.serve.batcher import chain_prefix_keys
+from ray_lightning_accelerators_tpu.serve.controller import (
+    LANE_DECODE, LANE_PREFILL, STATE_OPEN, STATE_SLOW,
+    PrefixAffinityRing)
+from ray_lightning_accelerators_tpu.serve.engine import BlockAllocator
+
+pytestmark = pytest.mark.prefix_affinity
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CFG = dict(vocab_size=61, d_model=32, n_heads=2, d_ff=64, n_layers=2,
+            max_seq_len=48)
+
+
+def _model(seed=0):
+    import jax
+
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    model = GPT(TransformerConfig(**_CFG))
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params
+
+
+def _ref(model, params, prompt, max_new):
+    return np.asarray(model.generate(
+        params, np.asarray(prompt)[None, :], max_new_tokens=max_new))[0]
+
+
+# --------------------------------------------------------------------- #
+# The shared chain-hash: one definition for both sides of routing       #
+# --------------------------------------------------------------------- #
+def test_chain_prefix_keys_commit_to_the_whole_prefix():
+    """Key j commits to tokens [0, (j+1)*block_len): equal keys imply
+    equal prefixes, a divergence poisons every later key, and partial
+    trailing blocks never get a key."""
+    p = np.arange(20, dtype=np.int32)
+    keys = chain_prefix_keys(p, 8)
+    assert len(keys) == 2                       # 20 // 8, tail dropped
+    assert keys == chain_prefix_keys(p, 8)      # deterministic
+    assert chain_prefix_keys(p, 8, limit=1) == keys[:1]
+    assert chain_prefix_keys(p[:7], 8) == []    # shorter than a block
+    # same first block, different second -> key 0 shared, key 1 not
+    q = p.copy()
+    q[10] += 1
+    keys_q = chain_prefix_keys(q, 8)
+    assert keys_q[0] == keys[0] and keys_q[1] != keys[1]
+    # a first-block divergence poisons the CHAIN: key 1 differs even
+    # though tokens [8, 16) are identical
+    r = p.copy()
+    r[0] += 1
+    keys_r = chain_prefix_keys(r, 8)
+    assert keys_r[0] != keys[0] and keys_r[1] != keys[1]
+    # input dtype/container must not change the hash (driver routes on
+    # what the engine's allocator registered, byte-for-byte)
+    assert chain_prefix_keys(list(range(20)), 8) == keys
+    assert chain_prefix_keys(np.arange(20, dtype=np.int64), 8) == keys
+
+
+def test_affinity_ring_ownership_residency_and_forgetting():
+    ring = PrefixAffinityRing(vnodes=4, residency_cap=3)
+    for r in (0, 1, 2):
+        ring.add_rank(r)
+    # consistent ownership: deterministic, and a fresh identical ring
+    # agrees (the hash is the map, not instance state)
+    ring2 = PrefixAffinityRing(vnodes=4, residency_cap=3)
+    for r in (0, 1, 2):
+        ring2.add_rank(r)
+    owners = {k: ring.owner_among(k, (0, 1, 2))
+              for k in ("alpha", "beta", "gamma", "delta")}
+    assert owners == {k: ring2.owner_among(k, (0, 1, 2))
+                      for k in owners}
+    # the successor walk: excluding a key's owner moves it to ANOTHER
+    # allowed rank, never None while any rank is allowed
+    k, own = next(iter(owners.items()))
+    fallback = ring.owner_among(k, tuple({0, 1, 2} - {own}))
+    assert fallback is not None and fallback != own
+    assert ring.owner_among(k, ()) is None
+    # residency scores the longest CONSECUTIVE run from key 0
+    ring.note(0, ["a", "b", "c"])
+    assert ring.resident_run(0, ["a", "b", "c"]) == 3
+    assert ring.resident_run(0, ["a", "x", "c"]) == 1   # gap stops it
+    assert ring.resident_run(0, ["x", "a"]) == 0
+    assert ring.resident_run(1, ["a"]) == 0
+    # bounded LRU: admitting past the cap evicts the oldest key
+    ring.note(0, ["d"])
+    assert ring.resident_run(0, ["a"]) == 0
+    assert ring.resident_run(0, ["b"]) == 1
+    # a restarted replica comes back blank but KEEPS its keyspace
+    ring.clear_rank(0)
+    assert ring.resident_run(0, ["b"]) == 0
+    assert ring.owner_among(k, (own,)) == own
+    # a removed rank's points leave the ring entirely
+    ring.remove_rank(own)
+    assert ring.owner_among(k, (0, 1, 2)) != own
+    json.dumps(ring.state())                     # snapshot-safe
+    assert ring.state()["vnodes"] == 4
+
+
+# --------------------------------------------------------------------- #
+# Routing units (fake group -- no subprocesses)                         #
+# --------------------------------------------------------------------- #
+class _FakeWorker:
+    def __init__(self, rank, alive=True):
+        self.rank = rank
+        self.is_alive = alive
+
+
+class _FakePool:
+    def __init__(self, n):
+        self.workers = [_FakeWorker(r) for r in range(n)]
+
+
+class _FakeBatcher:
+    def __init__(self):
+        self.depth = 0
+
+
+class _FakeGroup:
+    queue_depth = 16
+
+    def __init__(self, n=3):
+        self.pool = _FakePool(n)
+        self.batcher = _FakeBatcher()
+        self.metrics = ServeMetrics()
+        self.watchdog = None
+        self.dispatched = []
+
+    def _worker(self, rank):
+        for w in self.pool.workers:
+            if w.rank == rank:
+                return w
+        return None
+
+    def _dispatch(self, rank, chunk, hedge_of=None):
+        self.dispatched.append((rank, list(chunk), hedge_of))
+
+
+def _fake_item():
+    from ray_lightning_accelerators_tpu.serve.batcher import (
+        ServeRequest, ServeResponse)
+    req = ServeRequest(0, np.asarray([1], np.int32), 2, time.monotonic())
+    return req, ServeResponse(req)
+
+
+def test_route_affinity_hits_misses_and_health_override():
+    g = _FakeGroup(3)
+    ctrl = ReplicaController(g, ControllerConfig(affinity_vnodes=8))
+    keys = chain_prefix_keys(np.arange(32, dtype=np.int32), 8)
+    # cold prefix: placed on its ring owner, counted as a MISS
+    owner = ctrl.affinity.owner_among(keys[0], (0, 1, 2))
+    first = ctrl.route(prefix_keys=keys)
+    assert first == owner
+    assert g.metrics.snapshot()["prefix_route_misses"] == 1
+    # warm repeat: the resident run wins, counted as a HIT -- even when
+    # the owner is now the MOST loaded replica (affinity beats load)
+    ctrl.on_dispatch(first, [_fake_item()])
+    assert ctrl.route(prefix_keys=keys) == first
+    snap = g.metrics.snapshot()
+    assert snap["prefix_route_hits"] == 1
+    rows = ctrl.snapshot()["replicas"]
+    assert rows[str(first)]["prefix_hits"] == 1
+    assert rows[str(first)]["prefix_misses"] == 1
+    assert rows[str(first)]["prefix_hit_rate"] == 0.5
+    # health overrides affinity: the resident replica's open circuit
+    # routes the SAME prefix elsewhere, honestly counted as a miss
+    ctrl._replicas[first].state = STATE_OPEN
+    moved = ctrl.route(prefix_keys=keys)
+    assert moved is not None and moved != first
+    assert g.metrics.snapshot()["prefix_route_misses"] == 2
+    # ...and the new home becomes resident: the next route is a hit
+    assert ctrl.route(prefix_keys=keys) == moved
+    assert g.metrics.snapshot()["prefix_route_hits"] == 2
+    # snapshot carries the ring state for /statusz
+    snap = ctrl.snapshot()
+    assert snap["affinity"]["enabled"] is True
+    assert snap["affinity"]["ranks"] == [0, 1, 2]
+    assert snap["config"]["affinity"] is True
+    json.dumps(snap)
+    # keyless or affinity-off requests never touch the counters
+    g2 = _FakeGroup(2)
+    ctrl2 = ReplicaController(g2, ControllerConfig(affinity=False))
+    assert ctrl2.route(prefix_keys=keys) is not None
+    assert ctrl2.route() is not None
+    snap2 = g2.metrics.snapshot()
+    assert snap2["prefix_route_hits"] == 0
+    assert snap2["prefix_route_misses"] == 0
+
+
+def test_breaker_open_clears_residency_so_reroutes_stick():
+    """An opened circuit clears the replica's tracked residency (a
+    restarted engine is blank): after revival its old prefixes do NOT
+    pull traffic back on stale-residency hits."""
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig())
+    keys = chain_prefix_keys(np.arange(16, dtype=np.int32), 8)
+    home = ctrl.route(prefix_keys=keys)
+    assert ctrl.affinity.resident_run(home, keys) == len(keys)
+    cid = ctrl.on_dispatch(home, [_fake_item()])
+    g._worker(home).is_alive = False
+    ctrl.note_infra_failure(home, cid, RuntimeError("worker died"))
+    assert ctrl._replicas[home].state == STATE_OPEN
+    assert ctrl.affinity.resident_run(home, keys) == 0
+
+
+def test_hedge_counts_as_deliberate_prefix_miss():
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(hedge_age_s=0.05))
+    cid = ctrl.on_dispatch(0, [_fake_item()])
+    ctrl._replicas[0].state = STATE_SLOW
+    ctrl._replicas[0].chunks[cid].t_dispatch -= 1.0
+    assert ctrl.maybe_hedge() == 1
+    rank, _, hedge_of = g.dispatched[0]
+    assert rank == 1 and hedge_of == (0, cid)
+    # the hedge abandoned locality on purpose -- the target is charged
+    # a miss so the tier hit-rate stays honest about re-prefill cost
+    assert ctrl._replicas[1].prefix_misses == 1
+    assert g.metrics.snapshot()["prefix_route_misses"] == 1
+    ctrl.note_success(0, cid)
+
+
+def test_lane_assignment_filter_and_spill():
+    g = _FakeGroup(3)
+    ctrl = ReplicaController(g, ControllerConfig(prefill_replicas=1))
+    rows = ctrl.snapshot()["replicas"]
+    assert rows["0"]["lane"] == LANE_PREFILL
+    assert rows["1"]["lane"] == rows["2"]["lane"] == LANE_DECODE
+    assert ctrl.route(lane=LANE_PREFILL) == 0
+    assert ctrl.route(lane=LANE_DECODE) in (1, 2)
+    # availability beats disaggregation: an empty decode lane spills
+    # onto the prefill replica rather than stalling the queue
+    ctrl._replicas[1].state = STATE_OPEN
+    ctrl._replicas[2].state = STATE_OPEN
+    assert ctrl.route(lane=LANE_DECODE) == 0
+    # lane gauges: replica counts + per-lane in-flight requests
+    ctrl._replicas[1].state = STATE_OPEN  # still down
+    ctrl.on_dispatch(0, [_fake_item(), _fake_item()])
+    gauges = ctrl.lane_gauges()
+    assert gauges["lane_prefill_replicas"] == 1.0
+    assert gauges["lane_decode_replicas"] == 2.0
+    assert gauges["lane_prefill_inflight"] == 2.0
+    assert gauges["lane_decode_inflight"] == 0.0
+    # lanes disabled: everyone reports under decode, gauges stay live
+    ctrl2 = ReplicaController(_FakeGroup(2), ControllerConfig())
+    g2 = ctrl2.lane_gauges()
+    assert g2["lane_decode_replicas"] == 2.0
+    assert g2["lane_prefill_replicas"] == 0.0
+
+
+def test_note_import_moves_residency_without_counting_a_route():
+    """A KV import landing on a decode replica records residency there
+    (the decode replica now holds the blocks) but counts NO route: the
+    request's hit/miss was charged where the prefill routed."""
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig())
+    keys = chain_prefix_keys(np.arange(24, dtype=np.int32), 8)
+    before = g.metrics.snapshot()
+    ctrl.note_import(1, keys)
+    assert ctrl.affinity.resident_run(1, keys) == len(keys)
+    after = g.metrics.snapshot()
+    assert after["prefix_route_hits"] == before["prefix_route_hits"]
+    assert after["prefix_route_misses"] == before["prefix_route_misses"]
+    # the next same-prefix route follows the KV to the import target
+    assert ctrl.route(prefix_keys=keys) == 1
+    assert g.metrics.snapshot()["prefix_route_hits"] == 1
+
+
+# --------------------------------------------------------------------- #
+# BlockAllocator lifetimes under handoff                                #
+# --------------------------------------------------------------------- #
+def test_allocator_handoff_holds_pin_blocks_until_release():
+    a = BlockAllocator(n_blocks=6, block_len=8)   # 5 usable (+garbage)
+    blocks = a.alloc(4)
+    assert blocks is not None and len(blocks) == 4
+    keys = [f"k{i}" for i in range(4)]
+    for k, b in zip(keys, blocks):
+        assert a.register(k, b)
+    # mid-handoff the source still holds its reference: the registered
+    # blocks are NOT eviction fodder, so a demand the free list cannot
+    # cover fails instead of corrupting an in-flight copy
+    spare = a.alloc(1)
+    assert spare is not None
+    assert a.alloc(1) is None
+    assert a.stats()["cached"] == 0               # all still referenced
+    # decode took ownership: the source releases -- registered blocks
+    # stay CACHED (prefix-reusable) rather than returning to the free
+    # list, and only now become LRU-evictable
+    for b in blocks:
+        a.release(b)
+    a.release(spare[0])
+    st = a.stats()
+    assert st["cached"] == 4 and st["used"] == 0
+    # a fresh demand evicts oldest-first: k0's chain run dies, later
+    # keys survive individually
+    got = a.alloc(2)
+    assert got is not None and len(got) == 2
+    assert a.lookup_run(keys, 8) == []            # k0 evicted => no run
+    run1 = a.lookup_run(["k2"], 8)                # private query: alive
+    assert len(run1) <= 1
+    for b in run1:
+        a.release(b)
+    # first registration wins: neither an occupied key nor an
+    # already-keyed block re-registers
+    assert not a.register("k3", got[0])
+    survivor = next(b for k, b in zip(keys, blocks)
+                    if a.lookup_run([k], 8))
+    a.release(survivor)                           # undo the probe retain
+    assert not a.register("fresh-key", survivor)
+
+
+# --------------------------------------------------------------------- #
+# KV handoff end-to-end: two in-process engines                         #
+# --------------------------------------------------------------------- #
+def test_kv_handoff_token_identity_release_and_zero_recompiles():
+    """A completed prefill ships its block span to a second engine as a
+    block-id remap + wave-bounded object-store copy: greedy outputs are
+    token-identical to generate(), the source hold releases exactly
+    once, and a same-shape second handoff adds ZERO compiles (the
+    gather/scatter programs are memoized per wave width)."""
+    from ray_lightning_accelerators_tpu.analysis.compile_guard import (
+        compile_guard, install)
+    install()
+    model, params = _model()
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(1, 60, size=17).astype(np.int32)   # 2 full blocks
+    p2 = rng.integers(1, 60, size=17).astype(np.int32)
+    refs = [_ref(model, params, p, 5) for p in (p1, p2)]
+    pre = ServeEngine(model, params, max_slots=2, block_len=8).start()
+    dec = ServeEngine(model, params, max_slots=2, block_len=8).start()
+    try:
+        desc = pre.submit_handoff(p1, 5).result(timeout=300)
+        assert desc["block_len"] == 8 and desc["bytes"] > 0
+        assert len(desc["keys"]) == 2
+        out = dec.submit_import(desc).result(timeout=300)
+        np.testing.assert_array_equal(out, refs[0])
+        pstats = pre.stats()
+        assert pstats["kv_handoffs"] == 1
+        assert pstats["kv_handoff_bytes"] == desc["bytes"]
+        # the source hold releases exactly once (idempotent second call)
+        assert pre.release_handoff(desc["handoff_id"]) is True
+        assert pre.release_handoff(desc["handoff_id"]) is False
+        # warm path: a same-shape handoff end-to-end compiles NOTHING
+        with compile_guard(max_new_compiles=0, label="handoff-steady"):
+            desc2 = pre.submit_handoff(p2, 5).result(timeout=300)
+            out2 = dec.submit_import(desc2).result(timeout=300)
+        np.testing.assert_array_equal(out2, refs[1])
+        assert pre.release_handoff(desc2["handoff_id"]) is True
+        # accounting: both engines completed their half of each request
+        assert pre.stats()["completed"] == 2
+        assert dec.stats()["completed"] == 2
+        assert pre.stats()["failed"] == dec.stats()["failed"] == 0
+    finally:
+        pre.stop(cancel_active=True, timeout=10)
+        dec.stop(cancel_active=True, timeout=10)
+
+
+def test_handoff_descriptor_deadline_survives_the_hop():
+    """The descriptor carries the request's absolute deadline across
+    the hop: an import whose deadline passed in transit is shed typed
+    BEFORE any decode compute, and the source hold still releases."""
+    model, params = _model()
+    rng = np.random.default_rng(5)
+    p = rng.integers(1, 60, size=17).astype(np.int32)
+    pre = ServeEngine(model, params, max_slots=2, block_len=8).start()
+    dec = ServeEngine(model, params, max_slots=2, block_len=8,
+                      slo=SloPolicy(ttft_target_s=5.0)).start()
+    try:
+        desc = pre.submit_handoff(p, 4).result(timeout=300)
+        assert desc["t_submit"] is not None
+        desc = dict(desc, deadline=time.monotonic() - 0.5)
+        resp = dec.submit_import(desc)
+        with pytest.raises(DeadlineExceeded):
+            resp.result(timeout=300)
+        assert dec.stats()["failed"] == 1
+        assert pre.release_handoff(desc["handoff_id"]) is True
+    finally:
+        pre.stop(cancel_active=True, timeout=10)
+        dec.stop(cancel_active=True, timeout=10)
+
+
+def test_import_rejects_mismatched_block_geometry():
+    """A descriptor from a different block geometry is refused typed at
+    submission -- scattering foreign-sized blocks would corrupt the
+    pool silently."""
+    model, params = _model()
+    rng = np.random.default_rng(7)
+    p = rng.integers(1, 60, size=17).astype(np.int32)
+    pre = ServeEngine(model, params, max_slots=2, block_len=8).start()
+    dec = ServeEngine(model, params, max_slots=2, block_len=16).start()
+    try:
+        desc = pre.submit_handoff(p, 3).result(timeout=300)
+        with pytest.raises(ValueError):
+            dec.submit_import(desc)
+        assert pre.release_handoff(desc["handoff_id"]) is True
+    finally:
+        pre.stop(cancel_active=True, timeout=10)
+        dec.stop(cancel_active=True, timeout=10)
+
+
+# --------------------------------------------------------------------- #
+# Crash during handoff: the replica-tier acceptance loop               #
+# --------------------------------------------------------------------- #
+def _lane_factory(np_params):
+    """Engine factory executed inside each worker (cloudpickled
+    closure; params travel as numpy).  block_len=8 matches the group's
+    affinity_block_len so driver-side chain keys agree with the
+    engines' prefix indexes."""
+    def make():
+        from ray_lightning_accelerators_tpu.models.transformer import (
+            GPT, TransformerConfig)
+        from ray_lightning_accelerators_tpu.serve import ServeEngine
+        model = GPT(TransformerConfig(**_CFG))
+        return ServeEngine(model, np_params, max_slots=4,
+                           queue_depth=64, block_len=8, slo=None)
+    return make
+
+
+@pytest.mark.chaos
+def test_lanes_survive_decode_crash_during_handoff(tmp_path):
+    """1 prefill + 1 decode replica; the decode replica crashes on its
+    FIRST chunk -- which, with lanes on, is necessarily a KV import in
+    flight.  The tier requeues the stranded requests head-of-line,
+    re-prefills them from scratch (exactly-once: no loss, no dup), the
+    breaker revives the crashed replica, and every response stays
+    token-identical to generate()."""
+    from ray_lightning_accelerators_tpu.serve import ServeReplicas
+    import jax
+
+    model, params = _model()
+    np_params = jax.tree.map(np.asarray, params)
+    ns = str(tmp_path / "chaos-ns")
+    hb = {"RLA_TPU_WORKER_HEARTBEAT_S": "0.1"}
+    envs = [dict(hb),
+            dict(hb, RLA_TPU_CHAOS="crash@replica1:chunk1:once",
+                 RLA_TPU_CHAOS_NS=ns)]
+    cfg = ControllerConfig(
+        hedge=False, prefill_replicas=1, handoff_min_blocks=1,
+        max_retries=4, retry_backoff_s=0.01, retry_backoff_cap_s=0.1,
+        revive_backoff_s=0.2, revive_backoff_cap_s=1.0, poll_s=0.05)
+    rng = np.random.default_rng(13)
+
+    def wave(n):
+        return [(rng.integers(1, 60, size=int(s)).astype(np.int32),
+                 int(m)) for s, m in zip(rng.integers(16, 25, size=n),
+                                         rng.integers(3, 6, size=n))]
+
+    group = ServeReplicas(
+        _lane_factory(np_params), num_replicas=2, chunk_size=2,
+        heartbeat_s=0.1, wedge_timeout_s=1.2, queue_depth=64,
+        env_per_worker=envs, controller=cfg, affinity_block_len=8)
+    try:
+        # keep waves coming until the crash fired, its requests came
+        # back through the requeue lane AND the replica revived through
+        # the breaker (bounded); every wave checked exact
+        deadline = time.monotonic() + 150
+        healed = False
+        while time.monotonic() < deadline:
+            pairs = wave(4)
+            refs = [_ref(model, params, p, m) for p, m in pairs]
+            handles = [group.submit(p, m) for p, m in pairs]
+            for ref, h in zip(refs, handles):
+                np.testing.assert_array_equal(h.result(timeout=300),
+                                              ref)
+            snap = group.metrics.snapshot()
+            if snap["requeued"] >= 1 and snap["revived"] >= 1:
+                healed = True
+                break
+        assert healed, group.stats()["controller"]
+        snap = group.stats()
+        rows = snap["controller"]["replicas"]
+        assert rows["1"]["infra_failures"] >= 1   # the crash fired
+        assert rows["0"]["lane"] == LANE_PREFILL
+        assert rows["1"]["lane"] == LANE_DECODE
+        assert snap["kv_handoffs"] >= 1
+        assert snap["kv_handoff_bytes"] > 0
+        # exactly-once over the whole run (and every response above was
+        # asserted token-identical)
+        assert snap["failed"] == 0
+        assert snap["cancelled"] == 0
+        assert snap["completed"] == snap["submitted"]
+    finally:
+        group.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# Observability: metrics contract, Prometheus typing, rla_top           #
+# --------------------------------------------------------------------- #
+def test_metrics_lane_gauges_and_reset_audit():
+    m = ServeMetrics()
+    for c in ("prefix_route_hits", "prefix_route_misses",
+              "kv_handoffs"):
+        m.inc(c)
+    m.inc("kv_handoff_bytes", 4096)
+    lanes = {"lane_prefill_replicas": 1, "lane_decode_replicas": 2,
+             "lane_prefill_inflight": 0, "lane_decode_inflight": 3}
+    m.bind_lanes(lambda: dict(lanes))
+    snap = m.snapshot()
+    assert snap["prefix_route_hits"] == 1
+    assert snap["prefix_route_misses"] == 1
+    assert snap["kv_handoffs"] == 1
+    assert snap["kv_handoff_bytes"] == 4096
+    assert snap["lane_decode_inflight"] == 3
+    # reset clears the counters; bound lane gauges stay wired (they
+    # read live controller state, not history)
+    m.reset()
+    snap = m.snapshot()
+    for c in ("prefix_route_hits", "prefix_route_misses",
+              "kv_handoffs", "kv_handoff_bytes"):
+        assert snap[c] == 0, c
+    assert snap["lane_prefill_replicas"] == 1
+    # one-lock snapshot contract: a bound gauge fn may itself touch the
+    # metrics object (the controller's lock never nests inside ours)
+    m2 = ServeMetrics()
+    m2.bind_lanes(lambda: (m2.inc("hedged"),
+                           {"lane_prefill_replicas": 0})[1])
+    assert m2.snapshot()["lane_prefill_replicas"] == 0
+
+
+def test_prometheus_typing_for_prefix_and_lane_families():
+    from ray_lightning_accelerators_tpu.telemetry.registry import (
+        MetricsRegistry)
+    from tests.utils import assert_prometheus_exposition
+
+    m = ServeMetrics()
+    m.inc("prefix_route_hits", 3)
+    m.inc("kv_handoffs", 2)
+    m.inc("kv_handoff_bytes", 8192)
+    m.bind_lanes(lambda: {"lane_prefill_replicas": 1,
+                          "lane_decode_replicas": 2,
+                          "lane_prefill_inflight": 0,
+                          "lane_decode_inflight": 1})
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(prefill_replicas=1))
+    keys = chain_prefix_keys(np.arange(16, dtype=np.int32), 8)
+    home = ctrl.route(prefix_keys=keys)
+    ctrl.route(prefix_keys=keys)                 # the warm hit
+    reg = MetricsRegistry()
+    reg.add_serve(m, rank=0)
+    reg.add_replica_controller(ctrl)
+    text = reg.prometheus_text()
+    assert_prometheus_exposition(text)
+    # tier tallies are counters (_total), lane occupancy gauges (bare)
+    assert 'rla_tpu_serve_prefix_route_hits_total{rank="0"} 3' in text
+    assert 'rla_tpu_serve_kv_handoffs_total{rank="0"} 2' in text
+    assert 'rla_tpu_serve_kv_handoff_bytes_total{rank="0"} 8192' in text
+    assert 'rla_tpu_serve_lane_decode_replicas{rank="0"} 2' in text
+    assert "rla_tpu_serve_lane_decode_replicas_total" not in text
+    # per-replica prefix tallies + hit-rate level, lane one-hot
+    assert (f'rla_tpu_serve_replica_prefix_hits_total'
+            f'{{replica="{home}"}} 1') in text
+    assert (f'rla_tpu_serve_replica_prefix_hit_rate'
+            f'{{replica="{home}"}} 0.5') in text
+    assert 'rla_tpu_serve_replica_lane{replica="0",lane="prefill"} 1' \
+        in text
+    assert 'rla_tpu_serve_replica_lane{replica="1",lane="decode"} 1' \
+        in text
+
+
+def test_rla_top_renders_lane_and_prefix_columns():
+    spec = importlib.util.spec_from_file_location(
+        "rla_top", os.path.join(_ROOT, "scripts", "rla_top.py"))
+    rla_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rla_top)
+    g = _FakeGroup(2)
+    ctrl = ReplicaController(g, ControllerConfig(prefill_replicas=1))
+    keys = chain_prefix_keys(np.arange(16, dtype=np.int32), 8)
+    home = ctrl.route(prefix_keys=keys)
+    ctrl.route(prefix_keys=keys)
+    status = {"rank": "driver", "trace_id": "t", "health": {},
+              "replica_controller": ctrl.snapshot()}
+    out = rla_top.render(status)
+    assert "serve tier: queue 0/16" in out
+    assert "lane" in out and "pfx-hit" in out
+    assert "affinity ring: vnodes" in out
+    lines = out.splitlines()
+    row0 = next(ln for ln in lines if ln.startswith("0 "))
+    row1 = next(ln for ln in lines if ln.startswith("1 "))
+    assert "prefill" in row0 and "decode" in row1
+    hot = row0 if home == 0 else row1
+    assert "0.50" in hot                          # 1 hit / 2 routes
+    # affinity disabled: the ring line disappears, the table survives
+    ctrl2 = ReplicaController(_FakeGroup(1),
+                              ControllerConfig(affinity=False))
+    out2 = rla_top.render({"rank": "driver", "health": {},
+                           "replica_controller": ctrl2.snapshot()})
+    assert "affinity ring" not in out2 and "pfx-hit" in out2
