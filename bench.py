@@ -21,7 +21,8 @@
 
 - ``gradexchange`` / ``input_pipeline`` / ``fsdp_exchange`` /
   ``paged_serve`` / ``mfu_overlap`` / ``perf_observatory`` /
-  ``live_plane`` / ``serve_resilience`` (CPU-mesh subprocess benches):
+  ``live_plane`` / ``serve_resilience`` / ``long_context``
+  (CPU-mesh subprocess benches):
   quantized-allreduce wire-bytes reduction, async-input-pipeline
   prefetch speedup, compressed-FSDP exchange, paged-KV-cache
   concurrency-per-HBM, the overlap-aware scan-gather + step autotune
@@ -775,6 +776,18 @@ def bench_pipeline() -> dict:
     return _run_cpu_probe("pipeline_probe.py", "pipeline")
 
 
+def bench_long_context() -> dict:
+    """Long-context fast-path bench (serve/engine.py chunked prefill +
+    core/trainer.py seq_parallel): inter-token p99 ratio
+    blocking/chunked while two 40-block prompts join three live decode
+    streams (must be strictly > 1 — chunking protects decode cadence),
+    with token-identity and zero-measured-window-compile evidence, plus
+    the seq_parallel=2 (ulysses) train-loss parity rel-err as a field —
+    on a forced-host-platform 8-device CPU mesh (see
+    ``_run_cpu_probe``)."""
+    return _run_cpu_probe("long_context_probe.py", "long_context")
+
+
 def bench_prefix_affinity() -> dict:
     """Prefix-affinity routing bench (serve/controller.py +
     serve/engine.py): a skewed shared-prefix workload (4 hot 384-token
@@ -798,7 +811,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "live_plane": bench_live_plane,
            "serve_resilience": bench_serve_resilience,
            "resize": bench_resize, "pipeline": bench_pipeline,
-           "prefix_affinity": bench_prefix_affinity}
+           "prefix_affinity": bench_prefix_affinity,
+           "long_context": bench_long_context}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -825,7 +839,7 @@ _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
                          "perf_observatory", "live_plane",
                          "serve_resilience", "resize", "pipeline",
-                         "prefix_affinity")
+                         "prefix_affinity", "long_context")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:
@@ -930,7 +944,7 @@ def main() -> None:
         default="mnist,gpt,cifar,decode,gradexchange,input_pipeline,"
                 "fsdp_exchange,paged_serve,mfu_overlap,perf_observatory,"
                 "live_plane,serve_resilience,resize,pipeline,"
-                "prefix_affinity",
+                "prefix_affinity,long_context",
         help=f"comma-separated subset of {sorted(BENCHES)}")
     parser.add_argument("--gate", action="store_true",
                         help="run no benches: gate a bench window "

@@ -20,6 +20,8 @@ from .data.prefetch import (DevicePrefetcher, PrefetchIterator,
                             prefetch_pipeline)
 from .parallel.collectives import TensorShardedParamsError
 from .parallel.mesh import MeshConfig, build_mesh
+from .parallel.ring_attention import ring_attention, ring_attention_sharded
+from .parallel.ulysses import ulysses_attention, ulysses_attention_sharded
 from .runtime.elastic import ElasticResizeError, ElasticRunner
 from .runtime.preemption import Preempted, PreemptionNotice, get_notice
 from .runtime.session import get_actor_rank, init_session, put_queue
@@ -45,6 +47,8 @@ __all__ = [
     "RandomDataset", "ShardedSampler",
     "PrefetchIterator", "DevicePrefetcher", "prefetch_pipeline",
     "MeshConfig", "build_mesh",
+    "ulysses_attention", "ulysses_attention_sharded",
+    "ring_attention", "ring_attention_sharded",
     "ElasticRunner", "ElasticResizeError", "TensorShardedParamsError",
     "Preempted", "PreemptionNotice", "get_notice",
     "get_actor_rank", "init_session", "put_queue",

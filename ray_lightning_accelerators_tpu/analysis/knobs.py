@@ -179,6 +179,12 @@ _register(Knob("RLA_TPU_PREEMPT_CONSENSUS_EVERY", "int", 8,
 _register(Knob("RLA_TPU_PREEMPT_GRACE_S", "float", None,
                "preemption grace budget in seconds; setting it installs "
                "the SIGTERM notice handler (runtime/preemption.py)"))
+_register(Knob("RLA_TPU_SEQ_PARALLEL_MODE", "str", "ulysses",
+               "default context-parallel attention strategy for "
+               "Trainer(seq_parallel>1) when seq_parallel_mode is not "
+               "passed: 'ulysses' (all_to_all head-scatter; needs heads "
+               "divisible by the axis) or 'ring' (ppermute KV rotation) "
+               "(core/trainer.py)"))
 _register(Knob("RLA_TPU_SERVE_AFFINITY", "bool", True,
                "prefix-affinity routing: send a request to the replica "
                "whose KV cache holds the longest resident run of its "
@@ -205,6 +211,15 @@ _register(Knob("RLA_TPU_SERVE_BROWNOUT_FRAC", "float", 0.9,
                "queue-depth fraction past which a saturated tier with "
                "no scale-up headroom sheds typed BrownoutShed "
                "(serve/controller.py)"))
+_register(Knob("RLA_TPU_SERVE_CHUNK_BLOCKS", "int", 8,
+               "big-chunk quantum, in KV blocks, a streaming long-prompt "
+               "prefill advances per engine loop while no decode slot is "
+               "active (serve/engine.py)"))
+_register(Knob("RLA_TPU_SERVE_CHUNK_MIN_BLOCKS", "int", 1,
+               "small-chunk quantum, in KV blocks, a streaming long-"
+               "prompt prefill advances between live decode waves; keeps "
+               "decode cadence bounded while the prefill cursor makes "
+               "progress (serve/engine.py)"))
 _register(Knob("RLA_TPU_SERVE_HANDOFF_MIN_BLOCKS", "int", 1,
                "minimum full prompt blocks before a request takes the "
                "prefill-lane + KV-handoff path (below it the request "

@@ -406,7 +406,15 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     # every decode step behind it)
     "serve/engine.py": ("ServeEngine._run", "BlockAllocator.alloc",
                         "BlockAllocator.release",
-                        "BlockAllocator.lookup_run"),
+                        "BlockAllocator.lookup_run",
+                        # the chunked-prefill cursor advance runs once
+                        # per cursor per loop iteration, between decode
+                        # waves — a host sync or stray jit there would
+                        # bill itself to every live stream's cadence
+                        # (the one deliberate sync is the TTFT gate in
+                        # _complete_cursor)
+                        "ServeEngine._advance_prefills",
+                        "ServeEngine._advance_cursor"),
     # the paged decode step is compiled INTO the serve loop: its builder
     # body (and the shared paged attention block) must stay
     # host-sync-free and build no jits
@@ -446,6 +454,11 @@ DEFAULT_HOT_ROOTS: Mapping[str, Tuple[str, ...]] = {
     # trial loop: its driver must not leak jit builds or stray host
     # syncs beyond the deliberate timing measurement it exists for
     "tune/run.py": ("autotune_step",),
+    # the sequence-parallel attention bodies run INSIDE the layer scan
+    # of every train step on a seq>1 mesh: their shard_map bodies must
+    # stay host-sync-free and build no jits
+    "parallel/ulysses.py": ("ulysses_attention",),
+    "parallel/ring_attention.py": ("ring_attention",),
     # the MPMD pipeline tick loop (worker) and step dispatcher (driver):
     # both run once per optimizer step; slot barriers and host-scalar
     # conversion live cross-module in parallel/mpmd/handoff.py BY DESIGN

@@ -26,6 +26,8 @@ times from one script (reference: README.md:34-36).
 
 from __future__ import annotations
 
+import copy
+import dataclasses
 import itertools
 import os
 import time
@@ -95,6 +97,8 @@ class Trainer:
                  pipeline_stages: int = 1,
                  pipeline_schedule: str = "1f1b",
                  pipeline_microbatches: int = 4,
+                 seq_parallel: int = 1,
+                 seq_parallel_mode: Optional[str] = None,
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -273,6 +277,55 @@ class Trainer:
                     "pipeline_stages > 1: the pipeline schedule already "
                     "accumulates pipeline_microbatches gradients per "
                     "optimizer step")
+        # sequence parallelism (parallel/ulysses.py, ring_attention.py):
+        # seq_parallel > 1 adds a `sequence` mesh axis composing with
+        # data×fsdp — activations shard on the sequence dim, attention
+        # routes through the Ulysses all_to_all head-scatter or the ring
+        # KV rotation INSIDE the layer scan (XLA overlaps the collective
+        # with per-layer compute, same placement argument as the scan
+        # param gather).  Params stay on their data/fsdp layout.
+        if not isinstance(seq_parallel, int) or seq_parallel < 1:
+            raise ValueError(
+                f"seq_parallel must be an int >= 1, got {seq_parallel!r}")
+        self.seq_parallel = seq_parallel
+        if seq_parallel_mode is None:
+            seq_parallel_mode = knobs.get_str("RLA_TPU_SEQ_PARALLEL_MODE",
+                                              "ulysses")
+        if seq_parallel_mode not in ("ulysses", "ring"):
+            raise ValueError(
+                f"seq_parallel_mode must be 'ulysses' or 'ring', got "
+                f"{seq_parallel_mode!r}")
+        self.seq_parallel_mode = seq_parallel_mode
+        if seq_parallel > 1:
+            if pipeline_stages > 1:
+                raise ValueError(
+                    "seq_parallel > 1 composes with the SPMD data×fsdp "
+                    "mesh, not with pipeline_stages > 1: the MPMD stage "
+                    "groups split layers across processes while the "
+                    "sequence axis splits activations within one program "
+                    "— shard sequence inside a stage via the stage "
+                    "group's own mesh instead")
+            if grad_compression is not None:
+                raise ValueError(
+                    "grad_compression wraps the forward in a full-manual "
+                    "shard_map (parallel/collectives.py "
+                    "build_local_grads), which cannot nest the "
+                    "ulysses/ring attention shard_map; run seq_parallel "
+                    "with the implicit fp32 exchange")
+            mesh_cfg = self.accelerator.mesh_config
+            if mesh_cfg.sequence not in (1, seq_parallel):
+                raise ValueError(
+                    f"seq_parallel={seq_parallel} conflicts with the "
+                    f"accelerator's mesh_config.sequence="
+                    f"{mesh_cfg.sequence}; pass one or the other")
+            if mesh_cfg.sequence != seq_parallel:
+                # inject the sequence axis without mutating the caller's
+                # accelerator (resize_in_memory idiom)
+                accelerator = copy.copy(self.accelerator)
+                accelerator.mesh_config = dataclasses.replace(
+                    mesh_cfg, sequence=seq_parallel)
+                accelerator._mesh = None
+                self.accelerator = accelerator
         # analytic bytes-on-wire record for the compiled gradient
         # exchange (collectives.wire_bytes_per_step); also mirrored onto
         # the profiler when one is attached
@@ -814,6 +867,12 @@ class Trainer:
                 collectives_lib.validate_scan_gather(param_sh, scanned)
             except collectives_lib.TensorShardedParamsError as e:
                 reason = str(e)
+        if reason is None and self._mesh is not None and \
+                mesh_lib.mesh_axis_size(
+                    self._mesh, mesh_lib.SEQUENCE_AXIS) > 1:
+            reason = ("mesh has a sequence axis: the in-scan gather's "
+                      "full-manual shard_map cannot nest the "
+                      "ulysses/ring attention shard_map")
         if reason is None and not any(
                 collectives_lib.fsdp_shard_dim(s) is not None
                 for k in scanned
@@ -997,12 +1056,61 @@ class Trainer:
                     stats["bytes_moved"], stats["waves"], seconds)
         return stats
 
+    def _apply_seq_parallel(self, module: TpuModule, seq: int) -> None:
+        """Typed refusals + module routing for a ``sequence`` mesh axis.
+
+        The module's attention must be context-parallel-aware (GPT's
+        ``cfg.context_parallel`` dispatch); its declared sequence length
+        must divide the axis, and the Ulysses head-scatter additionally
+        needs the head count divisible (ring has no such constraint).
+        The mode is written onto the module config so the dispatch in
+        ``GPT._attention`` — which sits INSIDE the layer scan, where XLA
+        overlaps the all_to_all/ppermute with per-layer compute — picks
+        the requested strategy."""
+        cfg = getattr(module, "cfg", None)
+        if cfg is None or not hasattr(cfg, "context_parallel"):
+            raise ValueError(
+                f"seq_parallel={seq} needs a context-parallel-aware "
+                f"module (one whose config carries `context_parallel`, "
+                f"e.g. models.GPT); {type(module).__name__} cannot "
+                f"shard its attention over a sequence axis")
+        max_seq = getattr(cfg, "max_seq_len", None)
+        if max_seq is not None and max_seq % seq != 0:
+            raise ValueError(
+                f"sequence length ({max_seq}) is not divisible by the "
+                f"sequence axis size ({seq}); pad max_seq_len or change "
+                f"seq_parallel")
+        n_heads = getattr(cfg, "n_heads", None)
+        if (self.seq_parallel_mode == "ulysses" and n_heads is not None
+                and n_heads % seq != 0):
+            raise ValueError(
+                f"ulysses needs heads ({n_heads}) divisible by the "
+                f"sequence axis size ({seq}); use "
+                f"seq_parallel_mode='ring' instead")
+        cfg.context_parallel = self.seq_parallel_mode
+
     def _compile(self, module: TpuModule, state: TrainState, example_batch):
         from ..parallel import collectives as collectives_lib
+        from ..parallel import plan as plan_lib
 
         mesh = self._mesh
         module.mesh = mesh  # models use this for sharding constraints
-        batch_sh = self.accelerator.batch_sharding(mesh)
+        seq = mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS)
+        if seq > 1:
+            if self.grad_compression is not None:
+                # reachable only via an accelerator-supplied sequence
+                # axis (Trainer(seq_parallel=..) refuses at __init__)
+                raise ValueError(
+                    "grad_compression wraps the forward in a full-manual "
+                    "shard_map (parallel/collectives.py "
+                    "build_local_grads), which cannot nest the "
+                    "ulysses/ring attention shard_map; run the sequence "
+                    "axis with the implicit fp32 exchange")
+            self._apply_seq_parallel(module, seq)
+            # per-leaf batch tree: sequence dim sharded where it divides
+            batch_sh = plan_lib.batch_shardings(mesh, example_batch)
+        else:
+            batch_sh = self.accelerator.batch_sharding(mesh)
         state_sh = self._resolve_state_shardings(module, state)
         self._gather_mode_eff, self._scanned_keys = ("tree", ())
         if self._fsdp_param_sh is not None:

@@ -56,6 +56,38 @@ def stacked_replica_spec() -> P:
     return P(mesh_lib.BATCH_AXES)
 
 
+def seq_batch_spec() -> P:
+    """Sequence-parallel batch/activation layout: ``[batch, seq, ...]``
+    with the batch dim over the batch axes and the sequence dim over the
+    ``sequence`` axis.  This is the input-side half of sequence
+    parallelism — the model's internal constraints keep activations on
+    this layout through the layer scan, and ulysses/ring re-shard around
+    the attention kernel only."""
+    return P(mesh_lib.BATCH_AXES, mesh_lib.SEQUENCE_AXIS)
+
+
+def batch_shardings(mesh: Mesh, batch: Any) -> Any:
+    """Per-leaf batch layout tree for ``jit`` in_shardings.  Without a
+    sequence axis every leaf takes the batch-axes prefix; with one,
+    rank>=2 leaves whose dim 1 divides the axis take
+    :func:`seq_batch_spec` so each device feeds only its sequence shard
+    (the activation-memory win starts at the input), and the rest stay
+    batch-only — a scalar label or ragged leaf must not refuse the whole
+    batch."""
+    base = NamedSharding(mesh, P(mesh_lib.BATCH_AXES))
+    seq = mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS)
+    if seq == 1:
+        return jax.tree.map(lambda _: base, batch)
+    seq_sh = NamedSharding(mesh, seq_batch_spec())
+
+    def leaf_sharding(x: Any) -> NamedSharding:
+        if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] % seq == 0:
+            return seq_sh
+        return base
+
+    return jax.tree.map(leaf_sharding, batch)
+
+
 def zero1_spec(mesh: Mesh, leaf: Any) -> P:
     """ZeRO-1 layout for one param-shaped leaf: dim 0 sharded over the
     batch axes when divisible, replicated otherwise (small biases and
@@ -128,13 +160,14 @@ class ShardingPlan:
     state_shardings: Any
     fsdp_param_shardings: Any = None
     zero1_update_shardings: Any = None
+    seq: int = 1
     per_replica_fields: Tuple[str, ...] = ("residual", "grad_accum")
 
     def describe(self) -> dict:
         """Schema summary (docs/API.md "plan schema"; also handy in
         telemetry payloads): world sizes + per-field leaf layout
         counts."""
-        out = {"dp": self.dp, "fsdp": self.fsdp,
+        out = {"dp": self.dp, "fsdp": self.fsdp, "seq": self.seq,
                "per_replica_fields": list(self.per_replica_fields),
                "fields": {}}
         for field in ("params", "opt_state", "residual", "grad_accum"):
@@ -211,4 +244,5 @@ def build_plan(mesh: Mesh, accelerator: Any, module: Any, state: Any,
         fsdp=mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS),
         state_shardings=state_sh,
         fsdp_param_shardings=fsdp_param_sh,
-        zero1_update_shardings=zero1_update_sh)
+        zero1_update_shardings=zero1_update_sh,
+        seq=mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS))

@@ -88,9 +88,15 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     """Mesh-level entry: q,k,v are [batch, heads, seq, head_dim] GLOBAL
     arrays (possibly traced under jit); sequence dim is sharded over the
     `sequence` axis, heads over `tensor`, batch over (data, fsdp)."""
-    if mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS) == 1:
+    seq_size = mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS)
+    if seq_size == 1:
         from ..ops.attention import flash_attention
         return flash_attention(q, k, v, causal, scale)
+    if q.shape[2] % seq_size != 0:
+        raise ValueError(
+            f"ring attention needs the sequence length ({q.shape[2]}) "
+            f"divisible by the sequence axis size ({seq_size}); pad the "
+            f"sequence or change the mesh")
     spec = P(mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
              mesh_lib.SEQUENCE_AXIS, None)
     body = functools.partial(ring_attention,

@@ -67,8 +67,18 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     """Mesh-level entry: q,k,v are [batch, heads, seq, head_dim] GLOBAL
     arrays (possibly traced under jit); sequence dim sharded over the
     `sequence` axis, heads over `tensor`, batch over (data, fsdp)."""
-    if mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS) == 1:
+    seq_size = mesh_lib.mesh_axis_size(mesh, mesh_lib.SEQUENCE_AXIS)
+    if seq_size == 1:
         return flash_attention(q, k, v, causal, scale)
+    if q.shape[2] % seq_size != 0:
+        raise ValueError(
+            f"ulysses needs the sequence length ({q.shape[2]}) divisible by "
+            f"the sequence axis size ({seq_size}); pad the sequence or "
+            f"change the mesh")
+    if q.shape[1] % seq_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by the sequence "
+            f"axis size ({seq_size}); use ring attention instead")
     spec = P(mesh_lib.BATCH_AXES, mesh_lib.TENSOR_AXIS,
              mesh_lib.SEQUENCE_AXIS, None)
     body = functools.partial(ulysses_attention,

@@ -335,7 +335,11 @@ class AdmissionController:
                     "request; block rounding cannot grant positions the "
                     "model was never shaped for)")
             # paged admission: judge against the pool's budgets, never a
-            # dense per-slot length the paging indirection made obsolete
+            # dense per-slot length the paging indirection made obsolete.
+            # A chunked-prefill engine passes a max_blocks_per_slot that
+            # spans the MODEL's max_seq_len (its cursor streams prompts
+            # longer than any single prefill bucket), so only the hard
+            # cap above and the pool below can refuse a long prompt.
             needed = blocks_for_request(
                 int(prompt.size),
                 1 if export_handoff else int(max_new_tokens),

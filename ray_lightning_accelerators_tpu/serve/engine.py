@@ -208,6 +208,31 @@ class _Slot:
         self.blocks = blocks or []        # physical KV blocks (paged)
 
 
+class _PrefillCursor:
+    """A long prompt streaming in through chunked prefill: blocks-so-far
+    plus the next prompt position to feed.  The cursor owns its blocks
+    (released exactly once on completion-failure/cancel/death, like a
+    slot's), but its slot's row in the engine's table array stays ZEROED
+    until completion — decode feeds inactive rows token 0 at position 0,
+    and that write must keep routing to the reserved garbage block, not
+    into a half-prefilled prompt's block 0."""
+
+    __slots__ = ("req", "resp", "blocks", "shared", "keys", "pos",
+                 "chunks", "t_start")
+
+    def __init__(self, req: ServeRequest, resp: ServeResponse,
+                 shared: List[int], keys: List[str], pos: int,
+                 t_start: float):
+        self.req = req
+        self.resp = resp
+        self.blocks = list(shared)   # grows as chunks land
+        self.shared = list(shared)   # prefix-cache hits (refcounted)
+        self.keys = keys
+        self.pos = pos               # next prompt position to feed
+        self.chunks = 0
+        self.t_start = t_start       # prefill-duration anchor
+
+
 class ServeEngine:
     """Continuous-batching greedy inference over one model replica.
 
@@ -225,6 +250,22 @@ class ServeEngine:
     ``pool_overcommit`` scales the admission-time worst-case block
     budget (> 1.0 banks on prefix sharing).  ``draft_model`` /
     ``draft_params`` / ``spec_k`` arm the speculative lane.
+
+    ``chunked_prefill`` (default on, paged only): prompts spanning more
+    than RLA_TPU_SERVE_CHUNK_BLOCKS KV blocks stream through
+    ``decode_chunk_paged`` in pool-bounded chunks INTERLEAVED with live
+    decode steps — big chunks while decode is idle, small
+    (RLA_TPU_SERVE_CHUNK_MIN_BLOCKS) chunks between decode waves — so
+    one long prompt monopolizes neither the decode cadence nor its
+    disaggregated prefill lane.  Admission then judges prompts against
+    the model's ``max_seq_len`` rather than the ``max_total_len``
+    bucket (the per-slot block table spans the model), a paused prefill
+    holds only its blocks-so-far, and the chunk buckets are the
+    existing prefill buckets so steady state compiles nothing new.
+    Token-identical to whole-prompt prefill (greedy argmax over the
+    same positions).  The speculative lane keeps blocking prefill (and
+    a draft model pins the table span to ``max_total_len`` — its dense
+    cache must cover every padded bucket).
 
     ``paged=False``: the PR 2 dense allocator; ``prompt_block`` then
     bounds prefill compile count (paged mode buckets by ``block_len``).
@@ -247,7 +288,8 @@ class ServeEngine:
                  draft_params: Any = None,
                  spec_k: int = 4,
                  slo: Any = "env",
-                 handoff_wave_bytes: Optional[int] = None):
+                 handoff_wave_bytes: Optional[int] = None,
+                 chunked_prefill: bool = True):
         import jax
 
         if model.cfg.sliding_window is not None:
@@ -336,6 +378,26 @@ class ServeEngine:
                 raise ValueError("block_len must be >= 1")
             headroom = self.spec_k if draft_model is not None else 0
             self.max_blocks_per_slot = -(-(W + headroom) // self.block_len)
+            # chunked long-prompt prefill: the per-slot block-table SPAN
+            # widens to the model's max_seq_len so admission stops
+            # refusing prompts longer than the max_total_len bucket —
+            # the pool budget (not the table width) bounds what can
+            # actually place.  Pool sizing, the one-full-request floor
+            # and the dense-equivalent gauge all stay keyed to
+            # max_total_len: capacity parity is about the DECODE working
+            # set, and a streaming prefill holds only its blocks-so-far.
+            self.chunked_prefill = bool(chunked_prefill)
+            from ..analysis import knobs as _knobs
+            self._chunk_blocks = max(1, _knobs.get_int(
+                "RLA_TPU_SERVE_CHUNK_BLOCKS", 8))
+            self._chunk_min_blocks = max(1, min(
+                _knobs.get_int("RLA_TPU_SERVE_CHUNK_MIN_BLOCKS", 1),
+                self._chunk_blocks))
+            self.table_blocks = self.max_blocks_per_slot
+            if self.chunked_prefill and draft_model is None:
+                self.table_blocks = max(
+                    self.table_blocks,
+                    -(-model.cfg.max_seq_len // self.block_len))
             if n_blocks is None:
                 # capacity parity with the dense allocator by default:
                 # the HBM win comes from sizing the pool BELOW this
@@ -366,14 +428,17 @@ class ServeEngine:
                 max_new_tokens_cap=max_new_tokens_cap,
                 block_len=self.block_len,
                 pool_blocks=self.n_blocks - 1,
-                max_blocks_per_slot=self.max_blocks_per_slot,
+                max_blocks_per_slot=self.table_blocks,
                 spec_headroom=headroom,
                 pool_overcommit=pool_overcommit,
                 hard_total_cap=model.cfg.max_seq_len,
                 slo_policy=self.slo_policy)
             self._tables = np.zeros(
-                (max_slots, self.max_blocks_per_slot), np.int32)
+                (max_slots, self.table_blocks), np.int32)
             self.metrics.bind_pool(self._pool_gauges)
+            self.metrics.bind_chunks(lambda: {
+                "active_long_prefills": sum(
+                    1 for c in self._cursors if c is not None)})
 
             def step_tokens(p, pool, tables, t, pos):
                 # argmax INSIDE the compiled step (compile-guard pins the
@@ -386,6 +451,7 @@ class ServeEngine:
             self._step = jax.jit(step_tokens,
                                  donate_argnums=(1,) if donate else ())
         else:
+            self.chunked_prefill = False  # dense rows cannot chunk-join
             self.prompt_block = max(1, prompt_block)
             self.batcher = AdmissionController(
                 queue_depth=queue_depth, max_total_len=W,
@@ -421,6 +487,7 @@ class ServeEngine:
         self._cache = None          # dense cache OR paged pool
         self._pool_bytes = 0        # measured placed pool bytes (paged)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._cursors: List[Optional[_PrefillCursor]] = [None] * max_slots
         self._spec_active = 0
         self._stop = threading.Event()
         self._cancel_active = False
@@ -672,6 +739,7 @@ class ServeEngine:
                      if self._pool_bytes else 0.0)
         row_bytes = per_block * self.max_blocks_per_slot
         active = sum(1 for s in self._slots if s is not None) \
+            + sum(1 for c in self._cursors if c is not None) \
             + self._spec_active
         used_bytes = st["used"] * per_block
         dense_eq = active * row_bytes
@@ -700,11 +768,27 @@ class ServeEngine:
                     self._admit()
                 active = [i for i, s in enumerate(self._slots)
                           if s is not None]
+                prefilling = self.paged and any(
+                    c is not None for c in self._cursors)
+                if self._stop.is_set() and self._cancel_active \
+                        and (active or prefilling):
+                    self._cancel_slots()
+                    continue
+                if prefilling:
+                    # cadence-aware chunk budget: big chunks while
+                    # decode is idle, small chunks between decode waves
+                    self._advance_prefills(decode_active=bool(active))
+                    # a cursor that completed THIS iteration just armed
+                    # its slot's block table: recompute the wave so the
+                    # row decodes now — a stale wave would feed token 0
+                    # at position 0 THROUGH the armed table and stomp
+                    # the prompt's first block of KV
+                    active = [i for i, s in enumerate(self._slots)
+                              if s is not None]
                 if active:
-                    if self._stop.is_set() and self._cancel_active:
-                        self._cancel_slots()
-                        continue
                     self._decode_step(active)
+                elif prefilling:
+                    continue  # cursors advancing; no decode, no sleep
                 elif self._stop.is_set():
                     return
                 else:
@@ -717,6 +801,14 @@ class ServeEngine:
                         self.metrics.inc("failed")
                     self._release_request(s.req, s.blocks)
                 self._slots[i] = None
+            for i, cur in enumerate(self._cursors):
+                # a mid-stream prefill's blocks-so-far release exactly
+                # once, like a slot's (the tier requeues the request)
+                if cur is not None:
+                    if cur.resp._fail(e):
+                        self.metrics.inc("failed")
+                    self._release_request(cur.req, cur.blocks)
+                self._cursors[i] = None
             n = self.batcher.shutdown()
             if n:  # keep completed+failed+cancelled == submitted honest
                 self.metrics.inc("cancelled", n)
@@ -859,6 +951,7 @@ class ServeEngine:
         if self.paged:
             st = self.allocator.stats()
             active = sum(1 for s in self._slots if s is not None) \
+                + sum(1 for c in self._cursors if c is not None) \
                 + self._spec_active
             self.metrics.observe_pool(st["used"], active)
 
@@ -939,12 +1032,23 @@ class ServeEngine:
         jnp = self._jax.numpy
         admitted = 0
         for i in range(self.max_slots):
-            if self._slots[i] is not None:
+            if self._slots[i] is not None or self._cursors[i] is not None:
                 continue
             item = self._pop_admittable()
             if item is None:
                 break
             req, resp = item
+            if self.paged and self.chunked_prefill \
+                    and req.import_handoff is None and not req.speculative \
+                    and int(req.prompt.size) \
+                    > self._chunk_blocks * self.block_len:
+                # long prompt: stream it through a prefill cursor the
+                # loop advances between decode waves (no upfront block
+                # placement — a paused prefill holds only its
+                # blocks-so-far, allocated chunk by chunk)
+                self._start_cursor(i, req, resp)
+                admitted += 1
+                continue
             if self.paged and req.import_handoff is not None:
                 # decode-lane entry: no prefill, just a block remap
                 if not self._admit_import(i, req, resp):
@@ -997,17 +1101,19 @@ class ServeEngine:
         # queue wait = admission -> this slot-join moment; ttft below
         # is queue_wait + prefill by construction
         self.metrics.observe_queue_wait(t_a - req.t_submit)
+        self.metrics.observe_long_prefill(int(req.prompt.size))
         start = len(shared) * self.block_len
         sfx = req.prompt[start:]
         P = -(-int(sfx.size) // self.block_len) * self.block_len
         padded = np.zeros((1, P), np.int32)
         padded[0, :sfx.size] = sfx
-        table = np.zeros((self.max_blocks_per_slot,), np.int32)
+        table = np.zeros((self.table_blocks,), np.int32)
         table[:len(blocks)] = blocks
         tok0, self._cache = self._chunk_prefill_fn(P)(
             self.params, self._cache, jnp.asarray(table),
             jnp.asarray(padded), jnp.int32(start),
             jnp.int32(int(sfx.size) - 1))
+        self.metrics.inc("prefill_chunks")
         self._register_prompt_blocks(req, blocks, shared, keys)
         # graftlint: ok(host-sync) — TTFT gate: the first token must
         first = int(np.asarray(tok0)[0])  # be real before it is timed
@@ -1082,6 +1188,155 @@ class ServeEngine:
             self._slots[i] = slot
             if self.paged:
                 self._tables[i, :] = table
+        self._observe_pool()
+
+    # -- chunked long-prompt prefill ------------------------------------- #
+    def _start_cursor(self, i: int, req: ServeRequest,
+                      resp: ServeResponse) -> None:
+        """Begin streaming a long prompt into slot ``i``: the prefix
+        lookup happens NOW (a hit's blocks are exact KV, so the cursor
+        starts past them), but blocks are otherwise allocated chunk by
+        chunk — a paused prefill holds only its blocks-so-far.  The
+        slot's table row stays zeroed until completion (see
+        :class:`_PrefillCursor`)."""
+        s0 = int(req.prompt.size)
+        t_a = time.monotonic()
+        # queue wait = admission -> the moment prefill starts; ttft at
+        # completion is queue_wait + (streamed) prefill by construction
+        self.metrics.observe_queue_wait(t_a - req.t_submit)
+        self.metrics.observe_long_prefill(s0)
+        shared: List[int] = []
+        keys: List[str] = []
+        if self.prefix_cache:
+            keys = self._prefix_keys(req.prompt)
+            if keys:
+                self.metrics.inc("prefix_lookups")
+            # keep >= 1 suffix token (the last position's hidden state
+            # must be computed to produce token 0)
+            shared = self.allocator.lookup_run(keys,
+                                               (s0 - 1) // self.block_len)
+            if shared:
+                self.metrics.inc("prefix_hits")
+                self.metrics.inc("prefix_hit_blocks", len(shared))
+        self._cursors[i] = _PrefillCursor(
+            req, resp, shared, keys, pos=len(shared) * self.block_len,
+            t_start=t_a)
+        telemetry.emit("serve_prefill_start", trace=req.trace_id,
+                       request=req.request_id, slot=i, prompt=s0,
+                       shared_blocks=len(shared), streamed=True)
+
+    def _advance_prefills(self, decode_active: bool) -> None:
+        """Advance every streaming prefill by ONE chunk this loop
+        iteration.  The chunk budget is cadence-aware: the big quantum
+        (RLA_TPU_SERVE_CHUNK_BLOCKS) while no decode slot is live, the
+        small one (RLA_TPU_SERVE_CHUNK_MIN_BLOCKS) between decode waves
+        — decode cadence stays bounded by one small chunk's compute.
+        Both quanta are fixed buckets of the existing chunk-prefill
+        program family, so steady state compiles nothing new."""
+        for i, cur in enumerate(self._cursors):
+            if cur is not None:
+                self._advance_cursor(i, cur, decode_active)
+
+    def _advance_cursor(self, i: int, cur: _PrefillCursor,
+                        decode_active: bool) -> None:
+        jnp = self._jax.numpy
+        C = (self._chunk_min_blocks if decode_active
+             else self._chunk_blocks) * self.block_len
+        s0 = int(cur.req.prompt.size)
+        rem = s0 - cur.pos
+        if rem <= C:
+            self._complete_cursor(i, cur)
+            return
+        # intermediate chunk at the exact quantum (no pad): allocate the
+        # blocks its real positions write, run it at its true positions
+        # through the table, discard the greedy token (position
+        # pos+C-1's continuation is recomputed exactly by later chunks'
+        # attention over these same blocks)
+        need = -(-(cur.pos + C) // self.block_len) - len(cur.blocks)
+        if need > 0:
+            fresh = self.allocator.alloc(need)
+            if fresh is None:
+                return  # pool full now; the cursor waits, holding
+                        # blocks-so-far (decode retires free blocks)
+            cur.blocks.extend(fresh)
+        table = np.zeros((self.table_blocks,), np.int32)
+        table[:len(cur.blocks)] = cur.blocks
+        chunk = np.ascontiguousarray(
+            cur.req.prompt[cur.pos:cur.pos + C].reshape(1, C))
+        t0 = time.monotonic()
+        _, self._cache = self._chunk_prefill_fn(C)(
+            self.params, self._cache, jnp.asarray(table),
+            jnp.asarray(chunk), jnp.int32(cur.pos), jnp.int32(C - 1))
+        cur.pos += C
+        cur.chunks += 1
+        self.metrics.inc("prefill_chunks")
+        if self.perf_timeline is not None:
+            self.perf_timeline.observe("prefill", time.monotonic() - t0)
+
+    def _complete_cursor(self, i: int, cur: _PrefillCursor) -> None:
+        """Final chunk: allocate the request's remaining (decode)
+        blocks, run the padded tail, surface the first token, and
+        promote the cursor to a live slot (or hand off / finish).  Pad
+        positions are safe exactly as in the whole-prompt path: writes
+        past the allocated span route to the garbage block through the
+        zeroed table tail, and in-span pads sit at positions >= s0 that
+        decode rewrites before the causal mask exposes them."""
+        jnp = self._jax.numpy
+        req, resp = cur.req, cur.resp
+        s0 = int(req.prompt.size)
+        needed = req.blocks_reserved or blocks_for_request(
+            s0, req.max_new_tokens, self.block_len)
+        need = needed - len(cur.blocks)
+        if need > 0:
+            fresh = self.allocator.alloc(need)
+            if fresh is None:
+                return  # pool full now; retry next loop iteration
+            cur.blocks.extend(fresh)
+        rem = s0 - cur.pos
+        P = -(-rem // self.block_len) * self.block_len
+        padded = np.zeros((1, P), np.int32)
+        padded[0, :rem] = req.prompt[cur.pos:]
+        table = np.zeros((self.table_blocks,), np.int32)
+        table[:len(cur.blocks)] = cur.blocks
+        t0 = time.monotonic()
+        tok0, self._cache = self._chunk_prefill_fn(P)(
+            self.params, self._cache, jnp.asarray(table),
+            jnp.asarray(padded), jnp.int32(cur.pos), jnp.int32(rem - 1))
+        cur.chunks += 1
+        self.metrics.inc("prefill_chunks")
+        self._register_prompt_blocks(req, cur.blocks, cur.shared,
+                                     cur.keys)
+        # graftlint: ok(host-sync) — TTFT gate: the first token must
+        first = int(np.asarray(tok0)[0])  # be real before it is timed
+        now = time.monotonic()
+        resp.ttft_s = now - req.t_submit
+        self.metrics.observe_ttft(resp.ttft_s)
+        if self._slo is not None:
+            self._slo.observe_ttft(resp.ttft_s, req)
+            self._slo.observe_deadline_met(req)
+        self.metrics.observe_prefill(now - cur.t_start)
+        if self.perf_timeline is not None:
+            self.perf_timeline.observe("prefill", now - t0)
+        telemetry.emit("serve_prefill", trace=req.trace_id,
+                       request=req.request_id, bucket=P, slot=i,
+                       shared_blocks=len(cur.shared), streamed=True,
+                       chunks=cur.chunks,
+                       ttft_ms=round(resp.ttft_s * 1e3, 3))
+        self._cursors[i] = None
+        if req.export_handoff:
+            # the disaggregated prefill lane rides the same cursor: the
+            # request's lifecycle on THIS engine ends here
+            self._export_handoff(req, resp, cur.blocks, cur.keys, first)
+            self._observe_pool()
+            return
+        if req.max_new_tokens == 1:
+            self._finish(req, resp, [first])
+            self._release_request(req, cur.blocks)
+        else:
+            self._slots[i] = _Slot(req, resp, pos=s0, first_token=first,
+                                   t_now=now, blocks=cur.blocks)
+            self._tables[i, :] = 0
+            self._tables[i, :len(cur.blocks)] = cur.blocks
         self._observe_pool()
 
     # -- KV handoff (disaggregated lanes) -------------------------------- #
@@ -1415,3 +1670,12 @@ class ServeEngine:
             if self.paged:
                 self._tables[i, :] = 0
             self._slots[i] = None
+        for i, cur in enumerate(self._cursors):
+            if cur is None:
+                continue
+            if cur.resp._fail(ServeCancelled(
+                    f"request {cur.req.request_id} cancelled "
+                    "mid-prefill: engine stopped with cancel_active")):
+                self.metrics.inc("cancelled")
+            self._release_request(cur.req, cur.blocks)
+            self._cursors[i] = None

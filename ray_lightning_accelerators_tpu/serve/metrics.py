@@ -69,7 +69,11 @@ class ServeMetrics:
                  # completed; kv_handoff_bytes = KV bytes those
                  # handoffs shipped through the object store
                  "prefix_route_hits", "prefix_route_misses",
-                 "kv_handoffs", "kv_handoff_bytes")
+                 "kv_handoffs", "kv_handoff_bytes",
+                 # chunked long-prompt prefill (serve/engine.py): one
+                 # increment per decode_chunk_paged call a streaming
+                 # prefill cursor advances (whole-prompt prefills count 1)
+                 "prefill_chunks")
 
     # pool/HBM fields are GAUGES (live values, not monotone counters);
     # telemetry/registry.py keys its Prometheus type choice off this set
@@ -90,6 +94,12 @@ class ServeMetrics:
     LANE_GAUGES = ("lane_prefill_replicas", "lane_decode_replicas",
                    "lane_prefill_inflight", "lane_decode_inflight")
 
+    # chunked-prefill occupancy: active_long_prefills is the live count
+    # of slots whose prompt is still streaming in (engine bind), and
+    # longest_prefill_tokens is the high-watermark prompt length ever
+    # admitted — levels, not tallies, so the registry types them gauge
+    CHUNK_GAUGES = ("active_long_prefills", "longest_prefill_tokens")
+
     def __init__(self, profiler: Optional[Profiler] = None):
         self.profiler = profiler or Profiler()
         self._lock = threading.Lock()
@@ -99,10 +109,12 @@ class ServeMetrics:
         self._peak_concurrent = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        self._longest_prefill = 0
         self._queue_depth: Callable[[], int] = lambda: 0
         self._pool_gauges: Optional[Callable[[], Dict[str, Any]]] = None
         self._slo_gauges: Optional[Callable[[], Dict[str, Any]]] = None
         self._lane_gauges: Optional[Callable[[], Dict[str, Any]]] = None
+        self._chunk_gauges: Optional[Callable[[], Dict[str, Any]]] = None
 
     # ------------------------------------------------------------------ #
     def bind_queue(self, depth_fn: Callable[[], int]) -> None:
@@ -130,6 +142,20 @@ class ServeMetrics:
         Merged outside the metrics lock like every bound gauge source,
         so the controller's own lock never nests inside this one."""
         self._lane_gauges = gauges_fn
+
+    def bind_chunks(self, gauges_fn: Callable[[], Dict[str, Any]]) -> None:
+        """Wire the live chunked-prefill occupancy gauge
+        (``active_long_prefills`` — the engine owns the cursor list).
+        Merged outside the metrics lock like every bound gauge source."""
+        self._chunk_gauges = gauges_fn
+
+    def observe_long_prefill(self, prompt_tokens: int) -> None:
+        """Record an admitted prompt length; the snapshot keeps the
+        high-watermark (``longest_prefill_tokens``) so probes can prove
+        a long-context request actually streamed through."""
+        with self._lock:
+            self._longest_prefill = max(self._longest_prefill,
+                                        int(prompt_tokens))
 
     def observe_pool(self, used_blocks: int, concurrent: int) -> None:
         """Record a pool-occupancy observation (engine calls at every
@@ -235,6 +261,7 @@ class ServeMetrics:
             max_batch = self._max_batch
             peak_used = self._peak_used_blocks
             peak_conc = self._peak_concurrent
+            longest_prefill = self._longest_prefill
             busy_s = ((self._t_last - self._t_first)
                       if self._t_first is not None
                       and self._t_last is not None else 0.0)
@@ -250,6 +277,9 @@ class ServeMetrics:
             out.update(self._slo_gauges())
         if self._lane_gauges is not None:
             out.update(self._lane_gauges())
+        if self._chunk_gauges is not None:
+            out.update(self._chunk_gauges())
+            out["longest_prefill_tokens"] = longest_prefill
         out["throughput_tok_s"] = (
             counters["tokens_generated"] / busy_s if busy_s > 0 else 0.0)
         out["ttft_s"] = pct(self.TTFT)
@@ -272,6 +302,7 @@ class ServeMetrics:
             self._max_batch = 0
             self._peak_used_blocks = 0
             self._peak_concurrent = 0
+            self._longest_prefill = 0
             self._t_first = None
             self._t_last = None
             self.profiler.reset()

@@ -262,7 +262,8 @@ class MetricsRegistry:
         for key in serve_keys:
             gauge = key in ("queue_depth", "busy_s", "throughput_tok_s",
                             "max_batch") or key in _SM.POOL_GAUGES \
-                or key in _SM.SLO_GAUGES or key in _SM.LANE_GAUGES
+                or key in _SM.SLO_GAUGES or key in _SM.LANE_GAUGES \
+                or key in _SM.CHUNK_GAUGES
             name = f"rla_tpu_serve_{_prom_name(key)}"
             if not gauge:
                 name = f"{name}_total"

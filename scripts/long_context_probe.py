@@ -1,0 +1,257 @@
+"""Long-context probe: chunked-vs-blocking decode cadence A/B plus a
+sequence-parallel training parity check, on a forced host-platform CPU
+mesh.
+
+Self-contained: forces ``JAX_PLATFORMS=cpu`` with 8 virtual devices
+BEFORE importing jax (matching the other CPU-mesh fallback probes), so
+it produces a real number on any machine — including one whose
+accelerator backend is wedged, which is exactly when bench.py falls
+back to it.
+
+Two parts:
+
+1. **Chunked-prefill cadence A/B**: the SAME workload — three live
+   decode streams plus two 320-token prompts (40 full blocks, well past
+   the chunk threshold) joining mid-stream — is served twice by a paged
+   engine, once with ``chunked_prefill=False`` (the whole 320-token
+   prefill runs as one program call between decode waves, stalling
+   every live stream for its full duration) and once with the default
+   chunked streaming (the prefill advances in small cadence-aware
+   chunks between waves).  The headline is the inter-token p99 ratio
+   blocking/chunked (>1 = chunking protects decode cadence).  The
+   chunked arm's long outputs are asserted token-identical to
+   standalone ``generate()``, its measured window is compile-guard
+   clean (every possible chunk bucket is a multiple of ``block_len``
+   at or under the big chunk quantum, so warming the whole-path
+   buckets 8..64 warms the entire chunk program family), and the HBM
+   ledger (pool bytes, peak blocks, per-slot table span) rides along.
+
+2. **Sequence-parallel parity**: the same 2-layer GPT fit twice on the
+   8-device mesh — data=2 x fsdp=2 baseline vs seq_parallel=2 (ulysses,
+   data=2 x fsdp=2 x seq=2 is 8 devices) — and the relative train-loss
+   difference is reported as ``seq_parallel_parity_rel_err`` (gated
+   direction=lower in PERF_BASELINE.json; ring parity is pinned in
+   tests/test_seq_parallel.py).
+
+Output (compile-count line, telemetry line, metric line LAST —
+the bench parser contract)::
+
+    {"probe": "long_context", "kind": "compile_count", ...}
+    {"probe": "long_context", "kind": "telemetry", ...}
+    {"metric": "long_context_cadence_ratio", "value": ...,
+     "unit": "ratio", "vs_baseline": ..., "token_identical": true,
+     "measured_window_compiles": 0, "seq_parallel_parity_rel_err": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BLOCK_LEN = 8
+LONG_LEN = 320               # 40 full blocks: 5x the 64-token chunk bar
+N_LONG = 2
+N_DECODE = 3                 # live decode streams the stall would hit
+DECODE_NEW = 48
+MAX_TOTAL_LEN = 384
+CADENCE_BAR = 1.0            # chunking must not lose to blocking
+
+_MODEL_CFG = dict(vocab_size=61, d_model=64, n_heads=4, d_ff=256,
+                  n_layers=3, max_seq_len=384)
+
+
+def _build(seed: int):
+    import jax
+
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+
+    model = GPT(TransformerConfig(**_MODEL_CFG))
+    return model, model.init_params(jax.random.PRNGKey(seed))
+
+
+def _engine(model, params, chunked: bool):
+    from ray_lightning_accelerators_tpu.serve import ServeEngine
+    return ServeEngine(model, params, max_slots=N_DECODE + 1,
+                       queue_depth=32, max_total_len=MAX_TOTAL_LEN,
+                       block_len=BLOCK_LEN, n_blocks=112,
+                       prefix_cache=False, idle_poll_s=0.002,
+                       chunked_prefill=chunked, slo=None)
+
+
+def _warm(eng, rng, vocab):
+    """Warm every program the measured window can touch: the decode
+    step, the long whole-prompt bucket (blocking arm), and — because
+    the whole-prompt paged path and the chunk path share one program
+    family keyed by padded suffix length — every chunk bucket, by
+    driving whole-path prompts at each multiple of block_len up to the
+    big chunk quantum (distinct random tokens: no accidental shared
+    prefix shortening a warm bucket)."""
+    import numpy as np
+    big = eng._chunk_blocks * eng.block_len
+    for s0 in list(range(BLOCK_LEN, big + 1, BLOCK_LEN)) + [LONG_LEN]:
+        p = rng.integers(1, vocab, size=(s0,)).astype(np.int32)
+        eng.submit(p, 2).result(timeout=300)
+
+
+def _drive(eng, short_prompts, long_prompts):
+    """Three decode streams, then the long prompts joining mid-stream
+    (one free slot each: admission is immediate, so the A/B contrasts
+    the PREFILL execution policy, not queueing)."""
+    import numpy as np
+    dec = [eng.submit(p, DECODE_NEW) for p in short_prompts]
+    time.sleep(0.05)
+    longs = []
+    for p in long_prompts:
+        longs.append(eng.submit(p, 4))
+        time.sleep(0.05)
+    outs = [np.asarray(h.result(timeout=300)) for h in longs]
+    for h in dec:
+        h.result(timeout=300)
+    return outs, eng.stats()
+
+
+def _sp_parity(seed: int) -> dict:
+    """Train-loss parity of seq_parallel=2 (ulysses) vs the plain
+    data=2 x fsdp=2 baseline on the forced 8-device mesh."""
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu import DataLoader, Trainer
+    from ray_lightning_accelerators_tpu.accelerators.base import (
+        Accelerator)
+    from ray_lightning_accelerators_tpu.data.loader import ArrayDataset
+    from ray_lightning_accelerators_tpu.models.transformer import (
+        GPT, TransformerConfig)
+    from ray_lightning_accelerators_tpu.parallel import mesh as mesh_lib
+
+    tokens = np.asarray(np.random.default_rng(seed).integers(
+        0, 64, size=(16, 16)), np.int32)
+
+    def fit(seqp, mode):
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                d_ff=64, n_layers=2, max_seq_len=16,
+                                fused_loss=True, loss_chunk_rows=64)
+        tr = Trainer(max_epochs=1, precision="f32", seed=0,
+                     enable_checkpointing=False,
+                     log_every_n_steps=10 ** 9,
+                     accelerator=Accelerator(
+                         mesh_lib.MeshConfig(data=2, fsdp=2)),
+                     seq_parallel=seqp, seq_parallel_mode=mode)
+        tr.fit(GPT(cfg), DataLoader(ArrayDataset(tokens), batch_size=8))
+        return float(tr.callback_metrics["train_loss"])
+
+    base = fit(1, None)
+    sp = fit(2, "ulysses")
+    return {"seq_parallel_parity_rel_err":
+            abs(sp - base) / max(abs(base), 1e-12),
+            "seq_parallel_loss": round(sp, 6),
+            "baseline_loss": round(base, 6),
+            "seq_parallel_mode": "ulysses"}
+
+
+def _p99(vals):
+    import numpy as np
+    return float(np.percentile(np.asarray(vals), 99)) if vals else 0.0
+
+
+def probe(seed: int) -> tuple:
+    import numpy as np
+
+    from ray_lightning_accelerators_tpu.analysis import compile_guard as cg
+
+    cg.install()
+    model, params = _build(seed)
+    vocab = model.cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    short_prompts = [rng.integers(1, vocab, size=(12,)).astype(np.int32)
+                     for _ in range(N_DECODE)]
+    long_prompts = [rng.integers(1, vocab,
+                                 size=(LONG_LEN,)).astype(np.int32)
+                    for _ in range(N_LONG)]
+
+    import jax.numpy as jnp
+    refs = [np.asarray(model.generate(params, jnp.asarray(p[None]),
+                                      max_new_tokens=4))[0]
+            for p in long_prompts]
+
+    # -- arm A: blocking whole-prompt prefill -------------------------- #
+    with _engine(model, params, chunked=False) as blk:
+        _warm(blk, rng, vocab)
+        blk.metrics.reset()
+        _, blk_snap = _drive(blk, short_prompts, long_prompts)
+    blk_p99 = blk_snap["token_latency_s"]["p99_s"]
+
+    # -- arm B: chunked streaming prefill (the fast path) -------------- #
+    with _engine(model, params, chunked=True) as chk:
+        _warm(chk, rng, vocab)
+        chk.metrics.reset()
+        window_start = cg.compile_count()
+        outs, chk_snap = _drive(chk, short_prompts, long_prompts)
+        window_compiles = cg.compile_count() - window_start
+        compile_rec = cg.compile_count_record("long_context",
+                                              window_start)
+        pool_bytes = chk._pool_bytes
+        table_blocks = chk.table_blocks
+        slot_blocks = chk.max_blocks_per_slot
+    chk_p99 = chk_snap["token_latency_s"]["p99_s"]
+    identical = all(np.array_equal(o, r) for o, r in zip(outs, refs))
+    ratio = blk_p99 / chk_p99 if chk_p99 else 0.0
+
+    from ray_lightning_accelerators_tpu.telemetry import (
+        probe_snapshot_record)
+    telemetry_rec = probe_snapshot_record("long_context", serve=chk_snap)
+
+    rec = {
+        "metric": "long_context_cadence_ratio",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        "vs_baseline": round(ratio / CADENCE_BAR, 4),
+        "long_prompt_tokens": LONG_LEN,
+        "long_prompt_blocks": LONG_LEN // BLOCK_LEN,
+        "decode_streams": N_DECODE,
+        "token_gap_p99_ms_blocking": round(1e3 * blk_p99, 3),
+        "token_gap_p99_ms_chunked": round(1e3 * chk_p99, 3),
+        "token_identical": bool(identical),
+        "measured_window_compiles": int(window_compiles),
+        "prefill_chunks": int(chk_snap["prefill_chunks"]),
+        "longest_prefill_tokens": int(chk_snap["longest_prefill_tokens"]),
+        "pool_bytes": int(pool_bytes),
+        "peak_used_blocks": int(chk_snap["peak_used_blocks"]),
+        "table_blocks_per_slot": int(table_blocks),
+        "admission_blocks_per_slot_dense_equiv": int(slot_blocks),
+        "accounting_exact": bool(
+            chk_snap["completed"] + chk_snap["failed"]
+            + chk_snap["cancelled"] == chk_snap["submitted"]),
+    }
+    rec.update(_sp_parity(seed))
+    return compile_rec, telemetry_rec, rec
+
+
+def main() -> None:
+    compile_rec = telemetry_rec = None
+    try:
+        compile_rec, telemetry_rec, rec = probe(
+            int(sys.argv[sys.argv.index("--seed") + 1])
+            if "--seed" in sys.argv else 0)
+    except Exception as e:
+        rec = {"metric": "long_context_cadence_ratio",
+               "value": 0, "unit": "ratio", "vs_baseline": 0.0,
+               "error": f"{type(e).__name__}: {e}"[:400]}
+    if compile_rec is not None:
+        print(json.dumps(compile_rec), flush=True)
+    if telemetry_rec is not None:
+        print(json.dumps(telemetry_rec), flush=True)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

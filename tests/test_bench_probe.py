@@ -138,13 +138,17 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_prefix_affinity",
         lambda: {"metric": "prefix_affinity_ttft_ratio",
                  "value": 3.1, "unit": "ratio", "vs_baseline": 3.1})
+    monkeypatch.setattr(
+        bench, "bench_long_context",
+        lambda: {"metric": "long_context_cadence_ratio",
+                 "value": 2.6, "unit": "ratio", "vs_baseline": 2.6})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 12
+    assert len(lines) == 13
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
@@ -158,6 +162,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert lines[9]["metric"] == "resize_inmem_vs_ckpt_downtime_ratio"
     assert lines[10]["metric"] == "pipeline_bubble_accuracy"
     assert lines[11]["metric"] == "prefix_affinity_ttft_ratio"
+    assert lines[12]["metric"] == "long_context_cadence_ratio"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -178,7 +183,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         "serve_resilience_completed_fraction",
         "resize_inmem_vs_ckpt_downtime_ratio",
         "pipeline_bubble_accuracy",
-        "prefix_affinity_ttft_ratio"]
+        "prefix_affinity_ttft_ratio",
+        "long_context_cadence_ratio"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -201,6 +207,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_pipeline",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_prefix_affinity",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_long_context",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -269,6 +277,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_prefix_affinity",
         lambda: {"metric": "prefix_affinity_ttft_ratio",
                  "value": 3.1, "unit": "ratio", "vs_baseline": 3.1})
+    monkeypatch.setattr(
+        bench, "bench_long_context",
+        lambda: {"metric": "long_context_cadence_ratio",
+                 "value": 2.6, "unit": "ratio", "vs_baseline": 2.6})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -289,7 +301,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "serve_resilience_completed_fraction",
         "resize_inmem_vs_ckpt_downtime_ratio",
         "pipeline_bubble_accuracy",
-        "prefix_affinity_ttft_ratio"]
+        "prefix_affinity_ttft_ratio",
+        "long_context_cadence_ratio"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -414,6 +427,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_prefix_affinity",
         lambda: {"metric": "prefix_affinity_ttft_ratio",
                  "value": 3.1, "unit": "ratio", "vs_baseline": 3.1})
+    monkeypatch.setattr(
+        bench, "bench_long_context",
+        lambda: {"metric": "long_context_cadence_ratio",
+                 "value": 2.6, "unit": "ratio", "vs_baseline": 2.6})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -433,6 +450,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "serve_resilience_completed_fraction" in metrics
     assert "resize_inmem_vs_ckpt_downtime_ratio" in metrics
     assert "prefix_affinity_ttft_ratio" in metrics
+    assert "long_context_cadence_ratio" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

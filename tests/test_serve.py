@@ -92,8 +92,12 @@ def test_admission_typed_backpressure():
     check would have refused against max_total_len is admitted when its
     blocks fit, and the typed rejection names both pool budgets."""
     model, params = _model(max_seq_len=48)
+    # chunked_prefill off: a streaming engine widens the per-slot table
+    # to the model's max_seq_len (tests/test_long_context_serve.py); the
+    # W-bucket budget this test pins needs the blocking admission span
     eng = ServeEngine(model, params, max_slots=1, queue_depth=3,
-                      max_total_len=24, block_len=16, n_blocks=9)
+                      max_total_len=24, block_len=16, n_blocks=9,
+                      chunked_prefill=False)
     try:
         eng.submit(np.asarray([1, 2], np.int32), 4)
         eng.submit(np.asarray([3], np.int32), 4)
