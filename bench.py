@@ -801,6 +801,17 @@ def bench_prefix_affinity() -> dict:
     return _run_cpu_probe("prefix_affinity_probe.py", "prefix_affinity")
 
 
+def bench_anomaly_guard() -> dict:
+    """Numeric-guard bench (runtime/guardian.py + core/trainer.py
+    in-step hooks): steady-state epoch-time ratio guarded/unguarded of
+    the same tiny-GPT fit on the 8-device CPU mesh (must stay <= 1.05 —
+    detection rides the existing metrics readback with zero extra syncs
+    and zero retraces, pinned by the measured-window compile count),
+    plus one full badbatch trip -> data blame -> quarantine -> resumed
+    skip recovery timed as ``recovery_s`` (see ``_run_cpu_probe``)."""
+    return _run_cpu_probe("anomaly_guard_probe.py", "anomaly_guard")
+
+
 BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "decode": bench_decode, "gradexchange": bench_gradexchange,
            "input_pipeline": bench_input_pipeline,
@@ -812,7 +823,8 @@ BENCHES = {"mnist": bench_mnist, "gpt": bench_gpt, "cifar": bench_cifar,
            "serve_resilience": bench_serve_resilience,
            "resize": bench_resize, "pipeline": bench_pipeline,
            "prefix_affinity": bench_prefix_affinity,
-           "long_context": bench_long_context}
+           "long_context": bench_long_context,
+           "anomaly_guard": bench_anomaly_guard}
 
 if os.environ.get("RLA_TPU_BENCH_SELFTEST"):
     # jax-free fixtures for tests/test_bench_probe.py's isolation tests
@@ -839,7 +851,8 @@ _CPU_FALLBACK_BENCHES = ("gradexchange", "input_pipeline",
                          "fsdp_exchange", "paged_serve", "mfu_overlap",
                          "perf_observatory", "live_plane",
                          "serve_resilience", "resize", "pipeline",
-                         "prefix_affinity", "long_context")
+                         "prefix_affinity", "long_context",
+                         "anomaly_guard")
 
 
 def _emit_cpu_fallbacks(done=()) -> int:

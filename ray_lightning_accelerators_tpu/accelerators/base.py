@@ -221,6 +221,10 @@ class Accelerator:
                          else jax.tree.map(lambda _: stacked,
                                            state.residual)),
             "grad_accum": accum_sh(getattr(state, "grad_accum", None)),
+            # guardian vector (runtime/guardian.py): one tiny replicated
+            # f32 leaf; None when the guard is off (pre-guardian pytree)
+            "guard_ema": (None if getattr(state, "guard_ema", None) is None
+                          else repl),
         }
         return state.replace(step=repl, params=param_sh, opt_state=opt_sh,
                              rng=repl, **extras)

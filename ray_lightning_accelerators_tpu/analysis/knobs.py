@@ -118,6 +118,29 @@ _register(Knob("RLA_TPU_FLASH_BLOCK_K", "int", 512,
 _register(Knob("RLA_TPU_GLOBAL_SEED", "int", None,
                "global seed honored by seed_everything(); exported to "
                "children (utils/seed.py)"))
+_register(Knob("RLA_TPU_GUARD", "bool", True,
+               "numeric anomaly guardian: in-step NaN/spike detection "
+               "riding the metrics readback, with rewind-and-skip "
+               "recovery (runtime/guardian.py)"))
+_register(Knob("RLA_TPU_GUARD_EMA_DECAY", "float", 0.9,
+               "decay of the traced grad-norm EMA envelope the spike "
+               "check compares against (runtime/guardian.py)"))
+_register(Knob("RLA_TPU_GUARD_MAX_REWINDS", "int", 2,
+               "rewind budget per fit: trips beyond it are terminal "
+               "(runtime/guardian.py, runtime/elastic.py)"))
+_register(Knob("RLA_TPU_GUARD_SPIKE_FACTOR", "float", 10.0,
+               "grad-norm spike threshold as a multiple of the EMA "
+               "envelope (runtime/guardian.py)"))
+_register(Knob("RLA_TPU_GUARD_SPIKE_FLOOR", "float", 1e-3,
+               "absolute grad norm below which the spike check never "
+               "fires — keeps a converged model's near-zero EMA from "
+               "tripping on jitter (runtime/guardian.py)"))
+_register(Knob("RLA_TPU_GUARD_UPDATE_RATIO_MAX", "float", 0.5,
+               "max update-norm / param-norm ratio before the guard "
+               "flags the step (runtime/guardian.py)"))
+_register(Knob("RLA_TPU_GUARD_WARMUP_STEPS", "int", 20,
+               "steps before the spike / update-ratio checks arm (the "
+               "EMA envelope needs history) (runtime/guardian.py)"))
 _register(Knob("RLA_TPU_INSIDE_WORKER", "bool", False,
                "set in spawned workers so nested code never re-launches "
                "a world (core/trainer.py, runtime)"))

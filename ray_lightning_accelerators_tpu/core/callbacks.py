@@ -161,16 +161,21 @@ class ModelCheckpoint(Callback):
     def _prune(self) -> None:
         """``keep_last_k`` retention GC (utils/checkpoint
         .prune_checkpoints): process 0 only, with every path this
-        callback still tracks (top-k snapshots, best, last) protected."""
+        callback still tracks (top-k snapshots, best, last) protected,
+        plus the numeric guardian's rewind anchor while a quarantine is
+        active — evicting the checkpoint an in-flight anomaly recovery
+        rewinds to would turn a cheap rewind into a cold restart."""
         if self.keep_last_k is None or self.dirpath is None:
             return
         import jax
 
+        from ..runtime import guardian as guardian_lib
         from ..utils import checkpoint as ckpt_lib
         if jax.process_index() != 0:
             return
         protect = [self.best_model_path, self.last_model_path]
         protect += [p for _score, p in self._saved]
+        protect += guardian_lib.protected_paths(self.dirpath)
         ckpt_lib.prune_checkpoints(self.dirpath, self.keep_last_k,
                                    protect=protect)
 

@@ -32,11 +32,18 @@ class TrainState:
     #   exchange (the only comms) runs once per accumulation boundary
     residual: Any = None
     grad_accum: Any = None
+    # numeric anomaly guardian (runtime/guardian.py): a tiny replicated
+    # f32[GUARD_WIDTH] vector carrying the grad-norm EMA envelope and
+    # sticky trip flags through the donated step; None when guard is off,
+    # keeping the unguarded state pytree (and every compiled program that
+    # consumes it) bit-identical to the pre-guardian build
+    guard_ema: Any = None
 
     @classmethod
     def create(cls, params: Any, tx: optax.GradientTransformation,
                rng: jax.Array, residual: Any = None,
-               grad_accum: Any = None) -> "TrainState":
+               grad_accum: Any = None,
+               guard_ema: Any = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -44,6 +51,7 @@ class TrainState:
             rng=rng,
             residual=residual,
             grad_accum=grad_accum,
+            guard_ema=guard_ema,
         )
 
     @property

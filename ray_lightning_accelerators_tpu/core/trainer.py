@@ -99,6 +99,7 @@ class Trainer:
                  pipeline_microbatches: int = 4,
                  seq_parallel: int = 1,
                  seq_parallel_mode: Optional[str] = None,
+                 guard: Any = "auto",
                  seed: Optional[int] = None):
         if max_epochs is None and max_steps is None:
             max_epochs = 1000
@@ -326,6 +327,22 @@ class Trainer:
                     mesh_cfg, sequence=seq_parallel)
                 accelerator._mesh = None
                 self.accelerator = accelerator
+        # numeric anomaly guardian (runtime/guardian.py): "auto" (default)
+        # reads the guard knob family (on unless RLA_TPU_GUARD=0),
+        # None disables — the step functions are then BIT-IDENTICAL to the
+        # pre-guardian build (no guard state leaf, no guard math in the
+        # trace); a GuardConfig uses it as-is.  Guarded steps fold the
+        # health flags into the compiled program and ride the existing
+        # metrics readback: zero extra device syncs, zero retraces.
+        from ..runtime import guardian as guardian_lib
+        if guard == "auto":
+            guard = guardian_lib.GuardConfig.from_env()
+        if guard is not None and not isinstance(
+                guard, guardian_lib.GuardConfig):
+            raise ValueError(
+                f"guard must be 'auto', None, or a GuardConfig, got "
+                f"{guard!r}")
+        self.guard = guard
         # analytic bytes-on-wire record for the compiled gradient
         # exchange (collectives.wire_bytes_per_step); also mirrored onto
         # the profiler when one is attached
@@ -371,6 +388,13 @@ class Trainer:
         # (saved_dp, current_dp) when the last restore crossed world
         # sizes (elastic scale-down/up); None for same-world restores
         self._resumed_world_resize: Optional[tuple] = None
+        # guardian host companion (runtime/guardian.py Guardian): bound at
+        # fit start when guard is on; tracks the dispatched-batch ring for
+        # blame attribution and owns the quarantine ledger
+        self._guardian = None
+        # chaos numeric faults (testing/chaos.py numeric layer) active for
+        # this process; parsed once per fit from RLA_TPU_CHAOS
+        self._chaos_numeric: tuple = ()
         self.module: Optional[TpuModule] = None
         self._state: Optional[TrainState] = None
         self._mesh = None
@@ -1089,9 +1113,62 @@ class Trainer:
                 f"seq_parallel_mode='ring' instead")
         cfg.context_parallel = self.seq_parallel_mode
 
+    def _claim_numeric_chaos(self) -> tuple:
+        """Numeric chaos faults this build injects (testing/chaos.py):
+        each is claimed through the chaos namespace at build time, so a
+        post-rewind recompile replays the offending window clean."""
+        from ..testing import chaos as chaos_lib
+        faults = getattr(self, "_chaos_numeric", ()) or ()
+        return tuple(f for f in faults
+                     if f.kind in ("nanloss", "gradspike", "bitflip")
+                     and chaos_lib.claim_numeric(f))
+
+    def _guard_tail(self, st: TrainState, new_state: TrainState, metrics,
+                    grads=None, stacked_local=None):
+        """Guardian hook shared by every step builder: fold the traced
+        health flags (runtime/guardian.py ``update``) into the state's
+        guard vector and pack them into ``metrics["guard"]`` so they ride
+        the readback the fit loop was doing anyway — no extra syncs, and
+        a scalar-only trace addition (no retraces, compile_guard-pinned).
+        A no-op returning its inputs untouched when the guard is off, so
+        ``guard=None`` steps stay bit-identical to the pre-guardian
+        build."""
+        if self.guard is None or getattr(st, "guard_ema", None) is None:
+            return new_state, metrics
+        from ..runtime import guardian as guardian_lib
+        loss = metrics.get("train_loss", jnp.float32(0.0))
+        gnorm = metrics.get("grad_norm")
+        if gnorm is None:
+            if grads is not None:
+                gnorm = optax.global_norm(grads)
+            elif stacked_local is not None:
+                # replica mean of the local micro-grads: the tensor the
+                # exchange is about to reduce
+                gnorm = optax.global_norm(jax.tree.map(
+                    lambda x: jnp.mean(x.astype(jnp.float32), axis=0),
+                    stacked_local))
+            else:
+                gnorm = jnp.float32(0.0)
+        delta = jax.tree.map(
+            lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+            new_state.params, st.params)
+        ratio = optax.global_norm(delta) / (
+            optax.global_norm(st.params) + 1e-12)
+        rank_bad = None
+        if stacked_local is not None:
+            rank_bad = guardian_lib.per_replica_bad(
+                stacked_local, self.guard.spike_factor)
+        new_g, gvec = guardian_lib.update(
+            self.guard, st.guard_ema, st.step, loss, gnorm, ratio,
+            rank_bad)
+        metrics = dict(metrics)
+        metrics["guard"] = gvec
+        return new_state.replace(guard_ema=new_g), metrics
+
     def _compile(self, module: TpuModule, state: TrainState, example_batch):
         from ..parallel import collectives as collectives_lib
         from ..parallel import plan as plan_lib
+        from ..testing import chaos as chaos_lib
 
         mesh = self._mesh
         module.mesh = mesh  # models use this for sharding constraints
@@ -1171,23 +1248,33 @@ class Trainer:
                 return loss, metrics
             return loss_fn
 
+        # numeric chaos faults (testing/chaos.py numeric layer) are baked
+        # into the TRACE at build time — claimed here so the recompile
+        # after a guardian rewind builds a clean step
+        chaos_numeric = self._claim_numeric_chaos()
+
         def train_step(st: TrainState, batch):
             step_rng = jax.random.fold_in(st.rng, st.step)
 
             (_, metrics), grads = jax.value_and_grad(
                 loss_fn_of(batch, step_rng), has_aux=True)(st.params)
+            for fault in chaos_numeric:
+                metrics, grads, _ = chaos_lib.apply_traced_numeric(
+                    fault, st.step, metrics, grads=grads)
             if self.log_grad_norm:
                 # micro-batch norm (see the log_grad_norm init comment)
                 metrics["grad_norm"] = optax.global_norm(grads)
             new_params, new_opt = apply_grads(grads, st.opt_state, st.params)
             new_state = st.replace(step=st.step + 1, params=new_params,
                                    opt_state=new_opt)
+            new_state, metrics = self._guard_tail(st, new_state, metrics,
+                                                  grads=grads)
             return new_state, step_metrics_lr(st, metrics)
 
         if self.grad_compression is not None:
             train_step = self._build_compressed_train_step(
                 module, mesh, batch_sh, loss_fn_of, apply_grads,
-                step_metrics_lr)
+                step_metrics_lr, chaos_numeric)
 
         def eval_step(params, batch):
             return module.validation_step(params, batch)
@@ -1233,7 +1320,7 @@ class Trainer:
 
     def _build_compressed_train_step(self, module, mesh, batch_sh,
                                      loss_fn_of, apply_grads,
-                                     step_metrics_lr):
+                                     step_metrics_lr, chaos_numeric=()):
         """The grad_compression train step: gradients are computed
         per-replica inside a shard_map (no implicit fp32 psum), exchanged
         through the quantized two-phase collective
@@ -1266,20 +1353,26 @@ class Trainer:
         if self._fsdp_param_sh is not None:
             return self._build_fsdp_train_step(
                 mesh, cfg, k, vag, extra, batch_sh, apply_grads,
-                step_metrics_lr)
+                step_metrics_lr, chaos_numeric)
         local_grad_fn = collectives_lib.build_local_grads(
             mesh, vag, batch_sh.spec, extra_metrics=extra)
         exchange_fn = collectives_lib.build_exchange(mesh, cfg)
+        from ..testing import chaos as chaos_lib
 
         def train_step(st: TrainState, batch):
             step_rng = jax.random.fold_in(st.rng, st.step)
             metrics, local = local_grad_fn(st.params, batch, step_rng)
+            for fault in chaos_numeric:
+                metrics, _, local = chaos_lib.apply_traced_numeric(
+                    fault, st.step, metrics, stacked=local)
             if k == 1:
                 grads, new_res = exchange_fn(local, st.residual)
                 new_params, new_opt = apply_grads(grads, st.opt_state,
                                                   st.params)
                 new_state = st.replace(step=st.step + 1, params=new_params,
                                        opt_state=new_opt, residual=new_res)
+                new_state, metrics = self._guard_tail(
+                    st, new_state, metrics, stacked_local=local)
                 return new_state, step_metrics_lr(st, metrics)
 
             acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
@@ -1308,12 +1401,15 @@ class Trainer:
             new_state = st.replace(step=st.step + 1, params=new_params,
                                    opt_state=new_opt, residual=new_res,
                                    grad_accum=new_acc)
+            new_state, metrics = self._guard_tail(
+                st, new_state, metrics, stacked_local=local)
             return new_state, step_metrics_lr(st, metrics)
 
         return train_step
 
     def _build_fsdp_train_step(self, mesh, cfg, k, vag, extra, batch_sh,
-                               apply_grads, step_metrics_lr):
+                               apply_grads, step_metrics_lr,
+                               chaos_numeric=()):
         """The compressed-FSDP (ZeRO-2/3) train step: params live SHARDED
         over the fsdp axis (with their optimizer state — 1/N each), the
         compute view is a bf16 all-gather, per-replica grads land back
@@ -1347,8 +1443,9 @@ class Trainer:
         but no full-size buffer ever exists) and gates only the
         optimizer update on the window boundary."""
         from ..parallel import collectives as collectives_lib
+        from ..testing import chaos as chaos_lib
 
-        def finish(st, metrics, gshard, new_res):
+        def finish(st, metrics, gshard, new_res, stacked_local=None):
             """Shared tail: apply now (k == 1) or accumulate the owned
             shards and update at the window boundary."""
             if k == 1:
@@ -1356,6 +1453,9 @@ class Trainer:
                                                   st.params)
                 new_state = st.replace(step=st.step + 1, params=new_params,
                                        opt_state=new_opt, residual=new_res)
+                new_state, metrics = self._guard_tail(
+                    st, new_state, metrics, grads=gshard,
+                    stacked_local=stacked_local)
                 return new_state, step_metrics_lr(st, metrics)
 
             acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
@@ -1382,6 +1482,9 @@ class Trainer:
             new_state = st.replace(step=st.step + 1, params=new_params,
                                    opt_state=new_opt, residual=new_res,
                                    grad_accum=new_acc)
+            new_state, metrics = self._guard_tail(
+                st, new_state, metrics, grads=gshard,
+                stacked_local=stacked_local)
             return new_state, step_metrics_lr(st, metrics)
 
         if self._gather_mode_eff == "scan":
@@ -1401,6 +1504,9 @@ class Trainer:
                 compute_params = prelude(st.params)
                 metrics, grads = local_scan_fn(compute_params, batch,
                                                step_rng)
+                for fault in chaos_numeric:
+                    metrics, grads, _ = chaos_lib.apply_traced_numeric(
+                        fault, st.step, metrics, grads=grads)
                 # scanned leaves came back finished (exact mean, owner
                 # layout — the in-scan gather's transpose); only the
                 # rest rides the quantized exchange
@@ -1432,8 +1538,11 @@ class Trainer:
             step_rng = jax.random.fold_in(st.rng, st.step)
             compute_params = gather_fn(st.params)
             metrics, local = local_grad_fn(compute_params, batch, step_rng)
+            for fault in chaos_numeric:
+                metrics, _, local = chaos_lib.apply_traced_numeric(
+                    fault, st.step, metrics, stacked=local)
             gshard, new_res = exchange_fn(local, st.residual)
-            return finish(st, metrics, gshard, new_res)
+            return finish(st, metrics, gshard, new_res, stacked_local=local)
 
         return train_step
 
@@ -1543,6 +1652,12 @@ class Trainer:
             return False
         if self.profiler is not None:
             return False
+        # an active quarantine (runtime/guardian.py) needs the per-batch
+        # skip seam of the step loop; badbatch chaos needs the host path
+        if self._guardian is not None and self._guardian.has_quarantine():
+            return False
+        if any(f.kind == "badbatch" for f in self._chaos_numeric):
+            return False
 
         def overrides_batch_end(c) -> bool:
             fn = getattr(c, "on_train_batch_end", None)
@@ -1612,10 +1727,15 @@ class Trainer:
             if hits:
                 # graftlint: ok(host-sync) — one post-epoch readback of
                 host = jax.device_get(stacked)  # the stacked metrics
+                g_stack = host.pop("guard", None)
                 for i in hits:
                     self._log_now({k: float(v[i])
                                    for k, v in host.items()},
                                   step=first_step + i + 1)
+                if g_stack is not None:
+                    # sticky flags: the last scanned row carries any trip
+                    # graftlint: ok(host-sync) — already on host (the
+                    self._guard_check(np.asarray(g_stack)[-1])  # get above)
 
         def budget_hit() -> bool:
             return bool(self.max_steps
@@ -2248,6 +2368,15 @@ class Trainer:
         self.accelerator.setup_environment()
         self._mesh = self.accelerator.build_mesh()
         self._bind_preemption()
+        # numeric anomaly guardian (runtime/guardian.py): host companion
+        # for blame attribution + the quarantine ledger; chaos numeric
+        # faults (testing/chaos.py) parsed once per fit
+        from ..runtime import guardian as guardian_lib
+        from ..testing import chaos as chaos_lib
+        self._chaos_numeric = chaos_lib.numeric_faults()
+        self._guardian = (guardian_lib.Guardian(self.guard,
+                                                self.default_root_dir)
+                          if self.guard is not None else None)
         # live telemetry plane: the per-process server starts once (when
         # RLA_TPU_METRICS_PORT is configured — on workers it was already
         # started at boot) and this fit's trainer becomes its live
@@ -2292,6 +2421,12 @@ class Trainer:
                     module, init_params, self._mesh)
                 state = state.replace(residual=residual,
                                       grad_accum=grad_accum)
+        if self.guard is not None and \
+                getattr(state, "guard_ema", None) is None:
+            # fresh guard vector; a restore below reconciles against this
+            # template (older guard-less checkpoints keep it fresh)
+            state = state.replace(
+                guard_ema=jnp.asarray(guardian_lib.fresh_state()))
         for c in self.callbacks:
             c.setup(self, module, "fit")
         if not live_resume:
@@ -2309,6 +2444,14 @@ class Trainer:
             if ckpt_path is not None:
                 with self._perf_phase("ckpt"):  # restore cost is a phase
                     state = self._restore(ckpt_path, state)
+                if self.guard is not None and \
+                        getattr(state, "guard_ema", None) is not None:
+                    # a restore (including the guardian's own rewind)
+                    # restarts the guard fresh: a sticky trip that was
+                    # checkpointed must not re-raise on the first post-
+                    # rewind readback
+                    state = state.replace(
+                        guard_ema=jnp.asarray(guardian_lib.fresh_state()))
 
         example_batch = next(iter(train_loader))
         self._example_batch = example_batch
@@ -2364,6 +2507,18 @@ class Trainer:
             else:
                 source = (("host", b)
                           for b in self._iter_profiled(train_loader))
+            # guardian seams, applied to the HOST-ORDER stream before
+            # prefetch placement: quarantined batch indices become
+            # ("skip", None) sentinels (a pure function of the ledger —
+            # identical on every rank and every restart), and badbatch
+            # chaos poisons the batch feeding its 1-based global step
+            skip = (self._guardian.skip_set(self.current_epoch)
+                    if self._guardian is not None else set())
+            badbatch = tuple(f for f in self._chaos_numeric
+                             if f.kind == "badbatch")
+            if skip or badbatch:
+                source = self._wrap_fit_source(source, skip, badbatch,
+                                               self.global_step)
             pf = None
             if self.prefetch_batches:
                 if self.limit_train_batches is not None:
@@ -2386,12 +2541,19 @@ class Trainer:
                     if (self.limit_train_batches is not None
                             and batch_idx >= self.limit_train_batches):
                         break
+                    if kind == "skip":
+                        # quarantined window (runtime/guardian.py): the
+                        # batch never dispatches and global_step does not
+                        # advance; batch_idx keeps counting so the epoch
+                        # enumeration matches the clean run's loader order
+                        continue
                     state, train_metrics = self._fit_step(
                         state, kind, payload, pf, module, batch_idx)
                     if (self.val_check_interval
                             and self._val_loader is not None
                             and self.global_step % self.val_check_interval
                             == 0):
+                        self._guard_flush(train_metrics)
                         self._mid_epoch_validation(module)
                         self._last_val_step = self.global_step
                     # step-boundary preemption poll: drains into an
@@ -2433,6 +2595,12 @@ class Trainer:
             from ..utils import sharded_checkpoint as sharded_lib
             with self._perf_phase("ckpt"):  # checkpoint fence
                 sharded_lib.wait_until_finished()  # fence in-flight saves
+        if self._guardian is not None:
+            # the fit ran CLEAN to the end: newer verified checkpoints now
+            # cover the quarantined window, so the rewind anchor's prune
+            # protection can go (the skip entries stay — the data is
+            # still bad)
+            self._guardian.release_anchor()
         self.fitting = False
         if isinstance(self.logger, CSVLogger):
             self.logger.finalize()
@@ -2468,6 +2636,94 @@ class Trainer:
                           lambda: tree_nbytes(self._device_cache))
         hbm.sample()
 
+    def _wrap_fit_source(self, source, skip, badbatch_faults,
+                         start_step: int):
+        """Guardian/chaos wrap over the host-order fit source (runs on
+        the PRODUCER side, before any device placement): quarantined
+        batch indices yield ``("skip", None)`` sentinels — these pass
+        through ``_place_train_item`` untouched and the fit loop drops
+        them without advancing ``global_step`` — and ``badbatch`` chaos
+        poisons the host batch that will run as its 1-based global step
+        (claimed through the chaos namespace so a post-rewind replay of
+        the window stays clean)."""
+        from ..testing import chaos as chaos_lib
+
+        def gen():
+            dispatched = 0
+            for i, item in enumerate(source):
+                if i in skip:
+                    yield ("skip", None)
+                    continue
+                dispatched += 1
+                kind, payload = item
+                if kind == "host":
+                    for f in badbatch_faults:
+                        if (f.step or 1) == start_step + dispatched and \
+                                chaos_lib.claim_numeric(f):
+                            payload = chaos_lib.poison_batch(payload)
+                yield (kind, payload)
+
+        return gen()
+
+    def _guard_check(self, guard_host) -> None:
+        """Hand one already-materialized guard row to the guardian (no-op
+        while healthy; raises ``NumericAnomaly`` on a sticky trip)."""
+        if self._guardian is None or guard_host is None:
+            return
+        self._guardian.check(
+            guard_host, replay=self._build_guard_replay(),
+            compression_active=(self.grad_compression is not None
+                                or self.int8_matmul))
+
+    def _guard_flush(self, train_metrics) -> None:
+        """Materialize ONLY the guard vector and check it — the fence
+        before anything durable (mid-epoch validation checkpoints) can
+        observe post-anomaly state.  Gated on validation boundaries, so
+        the hot loop stays sync-free."""
+        if self._guardian is None or not isinstance(train_metrics, dict):
+            return
+        g = train_metrics.get("guard")
+        if g is None:
+            return
+        # graftlint: ok(host-sync) — validation-boundary fence
+        self._guard_check(jax.device_get(g))
+
+    def _build_guard_replay(self):
+        """Blame replay for the guardian (cold path, runs only on a
+        trip): recompute loss + grads for the suspect batch with NO
+        compressed exchange and NO int8 matmuls — a plain eager
+        value_and_grad on the current params.  The guardian splits
+        data-poisoned (reproduces plain) from exchange-induced
+        (reproduces only compressed) from nondeterministic/SDC (does not
+        reproduce) on its result."""
+        module, state = self.module, self._state
+        if module is None or state is None:
+            return None
+
+        def replay(payload):
+            int8_prev = getattr(module, "int8_matmul", False)
+            module.int8_matmul = False
+            try:
+                def lf(params):
+                    out = module.training_step(
+                        params, payload,
+                        jax.random.fold_in(state.rng, state.step))
+                    return out[0] if isinstance(out, tuple) else out
+
+                loss, grads = jax.value_and_grad(lf)(state.params)
+                gn = optax.global_norm(grads)
+                # graftlint: ok(host-sync) — post-trip cold path
+                loss_h, gn_h = jax.device_get((loss, gn))
+            finally:
+                module.int8_matmul = int8_prev
+            # loss_h/gn_h are host scalars (device_get above) and this
+            # replay runs only on the post-trip cold path
+            bad_loss = not bool(np.isfinite(loss_h))  # graftlint: ok(host-sync) — host scalar
+            bad_grad = not bool(np.isfinite(gn_h))  # graftlint: ok(host-sync) — host scalar
+            return {"loss_nonfinite": bad_loss, "grad_nonfinite": bad_grad}
+
+        return replay
+
     def _fit_step(self, state, kind, payload, pf, module,
                   batch_idx: int):
         """ONE optimizer step of the fit loop: place the batch, run the
@@ -2483,6 +2739,11 @@ class Trainer:
         tl = self.perf.timeline if self.perf is not None else None
         if tl is not None:
             tl.step_begin()
+        if self._guardian is not None:
+            # host refs only (no device work): what the step about to run
+            # as global step `global_step` consumes — the blame lookback
+            self._guardian.note_step(self.global_step, self.current_epoch,
+                                     batch_idx, kind, payload)
         try:
             if kind == "cached_local":
                 # synchronous path (prefetch off): the pipeline's
@@ -2518,8 +2779,10 @@ class Trainer:
                                      batch_idx)
             if self.global_step % self.log_every_n_steps == 0:
                 # graftlint: ok(host-sync) — log-interval-gated readback
-                self._log_now({f"{k}": float(v) for k, v in
-                               jax.device_get(train_metrics).items()})  # graftlint: ok(host-sync) — gated above
+                host = jax.device_get(train_metrics)  # graftlint: ok(host-sync) — gated above
+                guard_row = host.pop("guard", None)
+                self._guard_check(guard_row)
+                self._log_now({f"{k}": float(v) for k, v in host.items()})
             return state, train_metrics
         finally:
             if tl is not None:
@@ -2532,9 +2795,14 @@ class Trainer:
         harvest metrics, run epoch-boundary validation, fire callbacks,
         advance the epoch counter."""
         if train_metrics:
+            # graftlint: ok(host-sync) — epoch-boundary readback
+            host = jax.device_get(train_metrics)
+            guard_row = host.pop("guard", None)
+            # fence FIRST: a sticky trip must raise before checkpoint /
+            # early-stop callbacks can act on post-anomaly state
+            self._guard_check(guard_row)
             self.callback_metrics.update(
-                {k: float(v) for k, v in
-                 jax.device_get(train_metrics).items()})
+                {k: float(v) for k, v in host.items()})
 
         run_val = (self._val_loader is not None and
                    (self.current_epoch + 1) % self.check_val_every_n_epoch

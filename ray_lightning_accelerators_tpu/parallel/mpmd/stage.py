@@ -143,10 +143,11 @@ class StageRunner:
 
         def _apply_fn(p, opt, acc):
             grads = jax.tree_util.tree_map(lambda g: g * inv_m, acc)
+            gnorm = optax.global_norm(grads)
             updates, new_opt = self._tx.update(grads, opt, p)
             new_p = optax.apply_updates(p, updates)
             return new_p, new_opt, jax.tree_util.tree_map(
-                jnp.zeros_like, acc)
+                jnp.zeros_like, acc), gnorm
 
         self._apply = jax.jit(_apply_fn)
 
@@ -189,6 +190,7 @@ class StageRunner:
         acc = self._acc
         loss_sum = None
         metrics_sum = None
+        gnorm = None
         busy_s = 0.0
         ticks: List[Any] = []
 
@@ -262,7 +264,7 @@ class StageRunner:
                     acc = self._lane_grad_exchange(step, acc)
                 out, dt = handoff.timed_call(
                     self._apply, self.params, self.opt_state, acc)
-                self.params, self.opt_state, acc = out
+                self.params, self.opt_state, acc, gnorm = out
                 busy_s += dt
             ticks.append((op, m, dt))
             recorder.emit("pipeline_tick", step=step, stage=self.stage,
@@ -274,7 +276,7 @@ class StageRunner:
         if metrics_sum is not None:
             metrics_sum = jax.tree_util.tree_map(
                 lambda v: v / self.m_lane, metrics_sum)
-        return {"loss": loss_sum, "metrics": metrics_sum,
+        return {"loss": loss_sum, "metrics": metrics_sum, "gnorm": gnorm,
                 "busy_s": busy_s, "wall_s": wall_s, "ticks": ticks}
 
     # ------------------------------------------------------------------ #
@@ -339,12 +341,33 @@ def mpmd_stage_step(step: int,
                     ) -> Dict[str, Any]:
     """One optimizer step of this member's tick program; the summary
     crosses the pipe as host scalars (one conversion, here — never in
-    the tick loop)."""
+    the tick loop).  The same conversion doubles as the per-stage
+    numeric guard: a non-finite stage loss or post-apply grad norm
+    raises a typed ``NumericAnomaly`` naming THIS stage, so the driver's
+    retry layer gets blame attribution without any extra device sync."""
+    import math
+
     out = _RUNNER.run_step(step, input_refs)
     host = handoff.host_scalars(
-        {"loss": out["loss"], "metrics": out["metrics"]})
+        {"loss": out["loss"], "metrics": out["metrics"],
+         "gnorm": out["gnorm"]})
+    loss_h = host.get("loss")
+    gnorm_h = host.get("gnorm")
+    flags = {
+        "loss_nonfinite": bool(loss_h is not None
+                               and not math.isfinite(loss_h)),
+        "grad_nonfinite": bool(gnorm_h is not None
+                               and not math.isfinite(gnorm_h)),
+    }
+    if flags["loss_nonfinite"] or flags["grad_nonfinite"]:
+        from ...runtime.guardian import BLAME_UNKNOWN, NumericAnomaly
+        raise NumericAnomaly.for_trip(
+            step=step, blame=BLAME_UNKNOWN, flags=flags,
+            stage=_RUNNER.stage,
+            detail=f"loss={loss_h} grad_norm={gnorm_h}")
     return {"stage": _RUNNER.stage, "lane": _RUNNER.lane, "step": step,
             "loss": host["loss"], "metrics": host["metrics"],
+            "grad_norm": gnorm_h,
             "busy_s": out["busy_s"], "wall_s": out["wall_s"],
             "ticks": out["ticks"],
             "compiles": compile_guard.compile_count()}

@@ -26,7 +26,12 @@ primitives the runtime provides:
   runner with ``allow_shrink=True`` drops the rank and re-dispatches at
   the surviving world size (``args_per_worker`` receives it), the
   veScale-style alternative to burning every retry on an unrecoverable
-  host.
+  host;
+- numeric *rewind* (`runtime.guardian`): a typed ``NumericAnomaly`` from
+  a tripped in-step guard resumes WITHOUT charging the failure budget
+  (the fit body already rewound to a verified checkpoint and quarantined
+  the blamed data window), bounded separately by ``max_rewinds``; SDC
+  blame with a named suspect rank demotes that rank via elastic shrink.
 
 Recovery is checkpoint-based, matching the framework's training semantics:
 a collective (SPMD) step cannot survive losing a participant mid-step, so
@@ -85,6 +90,7 @@ class ElasticRunner:
                  min_workers: int = 1,
                  probe_timeout_s: float = 120.0,
                  max_preemptions: int = 3,
+                 max_rewinds: int = 2,
                  backoff_cap_s: float = DEFAULT_BACKOFF_CAP_S,
                  report_dir: Optional[str] = None):
         """``max_failures``: attempts beyond the first before giving up.
@@ -104,7 +110,18 @@ class ElasticRunner:
         ``args_per_worker`` to accept ``(attempt, world_size)`` so the
         dispatched work re-partitions.  ``min_workers`` floors the
         shrink.  ``max_preemptions`` bounds graceful-preemption resumes
-        (which do NOT consume the failure budget).
+        (which do NOT consume the failure budget).  ``max_rewinds``
+        separately bounds numeric-guard rewinds (``NumericAnomaly`` from
+        ``runtime/guardian.py``): a tripped guard has already rewound
+        state to a verified checkpoint and quarantined the blamed data
+        window, so the resume is cheap and does not consume the failure
+        budget either — but a guard that keeps tripping is a diverged
+        run, and the separate budget makes it terminal instead of an
+        infinite rewind loop.  A ``data``-blamed trip that recurs at the
+        SAME step after its window was quarantined is terminal
+        immediately (the quarantine demonstrably did not clear it), and
+        an ``sdc``-blamed trip with a named suspect rank demotes that
+        rank via elastic shrink when ``allow_shrink`` permits.
 
         ``resize_in_memory``: survivors of a failed attempt KEEP their
         process (and its live in-memory state — the dispatched body is
@@ -150,6 +167,7 @@ class ElasticRunner:
         self.min_workers = max(1, min_workers)
         self.probe_timeout_s = probe_timeout_s
         self.max_preemptions = max_preemptions
+        self.max_rewinds = max_rewinds
         self.attempts_used = 0
         # wedge diagnosis records accumulated across attempts (one dict
         # per reaped rank, runtime/watchdog.py death-record shape)
@@ -159,6 +177,9 @@ class ElasticRunner:
         # "world_size": new size})
         self.preempt_events: List[preempt_lib.Preempted] = []
         self.shrink_events: List[Dict[str, Any]] = []
+        # numeric-guard rewinds resumed (the tripped NumericAnomaly's
+        # structured diagnosis, one dict per rewound attempt)
+        self.anomaly_events: List[Dict[str, Any]] = []
         self.resize_in_memory = resize_in_memory
         # elastic GROW records under resize_in_memory ({"revived": ranks,
         # "world_size": new size, "attempt": n}): a previously dropped
@@ -217,6 +238,50 @@ class ElasticRunner:
                 raise_on_mismatch=False)
         except Exception:
             return None
+
+    def _numeric_anomaly(self, exc: BaseException):
+        """The typed numeric-guard verdict on a failed attempt, or None.
+        Wire-registered (``runtime/wire.py``), so an anomaly raised
+        inside a worker arrives here as a real ``NumericAnomaly`` with
+        its blame/suspect/step postmortem intact."""
+        try:
+            from .guardian import NumericAnomaly
+        except Exception:
+            return None
+        if isinstance(exc, NumericAnomaly):
+            return exc
+        # process_results can wrap the first failed future's exception;
+        # a one-level cause walk keeps the typed verdict reachable
+        cause = getattr(exc, "__cause__", None)
+        if isinstance(cause, NumericAnomaly):
+            return cause
+        return None
+
+    def _demote_suspect(self, anomaly: Any, attempt: int) -> None:
+        """SDC blame names a rank producing divergent numerics on
+        identical inputs — a hardware suspect.  Under ``allow_shrink``
+        the named rank is demoted via the same elastic-shrink path as a
+        lost host (floored by ``min_workers``); without shrink the rank
+        stays and the rewind alone is the recovery."""
+        suspect = anomaly.suspect_rank
+        if (not self.allow_shrink or suspect is None
+                or int(suspect) < 0):
+            return
+        suspect = int(suspect)
+        if not any(w.rank == suspect for w in self.pool.workers):
+            return
+        if len(self.pool) - 1 < self.min_workers:
+            log.warning(
+                "elastic SDC demotion skipped: dropping rank %d would "
+                "leave %d < min_workers=%d", suspect,
+                len(self.pool) - 1, self.min_workers)
+            return
+        dropped = self.pool.drop([suspect])
+        event = {"dropped": dropped, "world_size": len(self.pool),
+                 "attempt": attempt + 1, "blame": anomaly.blame}
+        self.shrink_events.append(event)
+        telemetry.emit("elastic_shrink", **event)
+        log.warning("elastic SDC demotion: %s", event)
 
     def _reset_collectives(self) -> None:
         """Attempt-entry spill reset (same knob gating): an attempt is
@@ -375,6 +440,10 @@ class ElasticRunner:
         attempt = 0
         failures = 0
         preemptions = 0
+        rewinds = 0
+        # data-blamed trip steps already quarantined once: a SECOND trip
+        # at the same step means the quarantine did not clear it
+        quarantined_steps: set = set()
         self.goodput.run_begin()
         # a fresh run must not inherit a previous run's (or a smaller
         # world's leftover) collective sequences
@@ -465,6 +534,44 @@ class ElasticRunner:
                     log.warning("attempt %d preempted (%s); resuming "
                                 "from emergency checkpoint",
                                 attempt + 1, preempted)
+                elif self._numeric_anomaly(e) is not None:
+                    # a tripped numeric guard is a REWIND, not a failure:
+                    # the fit body already rewound to a verified
+                    # checkpoint and (on data blame) quarantined the
+                    # blamed window, so the resume is cheap and the
+                    # failure budget stays intact — bounded separately
+                    # by max_rewinds
+                    anomaly = self._numeric_anomaly(e)
+                    self.anomaly_events.append(dict(anomaly.diagnosis))
+                    telemetry.emit("rewind", attempt=attempt + 1,
+                                   step=anomaly.step, blame=anomaly.blame,
+                                   suspect_rank=anomaly.suspect_rank)
+                    from .guardian import BLAME_DATA, BLAME_SDC
+                    if anomaly.blame == BLAME_DATA \
+                            and anomaly.step is not None:
+                        if anomaly.step in quarantined_steps:
+                            # deterministic: the quarantined window was
+                            # skipped and the SAME step still trips —
+                            # retrying cannot converge
+                            self._write_report(anomaly)
+                            raise RuntimeError(
+                                f"numeric anomaly at step {anomaly.step} "
+                                "recurred after its data window was "
+                                "quarantined — not a data fault; "
+                                "refusing to rewind again") from e
+                        quarantined_steps.add(anomaly.step)
+                    rewinds += 1
+                    if rewinds > self.max_rewinds:
+                        self._write_report(anomaly)
+                        raise RuntimeError(
+                            f"elastic run tripped the numeric guard "
+                            f"{rewinds} times (max_rewinds="
+                            f"{self.max_rewinds})") from e
+                    if anomaly.blame == BLAME_SDC:
+                        self._demote_suspect(anomaly, attempt)
+                    log.warning("attempt %d tripped the numeric guard "
+                                "(%s); rewinding to the last verified "
+                                "checkpoint", attempt + 1, anomaly)
                 else:
                     mismatch = self._collective_mismatch(e)
                     if mismatch is not None:

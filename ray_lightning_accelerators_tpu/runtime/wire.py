@@ -35,6 +35,7 @@ WIRE_EXCEPTION_NAMES = frozenset({
     "ObjectStoreError",
     "CollectiveMismatch",
     "PipelineHandoffTimeout",
+    "NumericAnomaly",
 })
 
 
@@ -44,6 +45,7 @@ def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
     from ..parallel.mpmd.handoff import PipelineHandoffTimeout
     from ..testing.spmd_sanitizer import CollectiveMismatch
     from .elastic import ElasticResizeError
+    from .guardian import NumericAnomaly
     from .object_store import ObjectStoreError
     from .preemption import Preempted
     from .queue import QueueShutdown
@@ -57,6 +59,7 @@ def _rebuilders() -> Dict[str, Callable[[str], BaseException]]:
         "ObjectStoreError": ObjectStoreError,
         "CollectiveMismatch": CollectiveMismatch.from_message,
         "PipelineHandoffTimeout": PipelineHandoffTimeout.from_message,
+        "NumericAnomaly": NumericAnomaly.from_message,
     }
 
 

@@ -442,11 +442,16 @@ class ServeEngine:
 
             def step_tokens(p, pool, tables, t, pos):
                 # argmax INSIDE the compiled step (compile-guard pins the
-                # program count); D2H per step is [B] tokens
+                # program count); D2H per step is [B] tokens + [B] health
+                # bits (the numeric guard: per-row all-finite logits,
+                # riding the feed-gate sync the loop pays anyway)
                 logits, pool = model.decode_step_rows_paged(
                     p, pool, tables, t, pos)
+                ok = jax.numpy.all(
+                    jax.numpy.isfinite(logits),
+                    axis=tuple(range(1, logits.ndim)))
                 return jax.numpy.argmax(logits, -1).astype(
-                    jax.numpy.int32), pool
+                    jax.numpy.int32), ok, pool
 
             self._step = jax.jit(step_tokens,
                                  donate_argnums=(1,) if donate else ())
@@ -462,8 +467,11 @@ class ServeEngine:
 
             def step_tokens(p, c, t, pos):
                 logits, cache = model.decode_step_rows(p, c, t, pos)
+                ok = jax.numpy.all(
+                    jax.numpy.isfinite(logits),
+                    axis=tuple(range(1, logits.ndim)))
                 return jax.numpy.argmax(logits, -1).astype(
-                    jax.numpy.int32), cache
+                    jax.numpy.int32), ok, cache
 
             self._step = jax.jit(step_tokens,
                                  donate_argnums=(1,) if donate else ())
@@ -1499,16 +1507,18 @@ class ServeEngine:
             poss[i] = s.pos
         t0 = time.monotonic()
         if self.paged:
-            toks_next, self._cache = self._step(
+            toks_next, row_ok, self._cache = self._step(
                 self.params, self._cache, jnp.asarray(self._tables),
                 jnp.asarray(toks), jnp.asarray(poss))
         else:
-            toks_next, self._cache = self._step(self.params, self._cache,
-                                                jnp.asarray(toks),
-                                                jnp.asarray(poss))
+            toks_next, row_ok, self._cache = self._step(
+                self.params, self._cache, jnp.asarray(toks),
+                jnp.asarray(poss))
         # deliberate: step k+1's input IS step k's output, so the loop
         # must materialize it — the one sync a greedy feed cannot avoid
         nxt = np.asarray(toks_next)  # graftlint: ok(host-sync) — feed gate
+        # the numeric guard's health bits ride that same materialization
+        okh = np.asarray(row_ok)  # graftlint: ok(host-sync) — feed gate
         now = time.monotonic()
         self.metrics.observe_step(now - t0, len(active))
         if self.perf_timeline is not None:
@@ -1520,6 +1530,28 @@ class ServeEngine:
         retired = False
         for i in active:
             s = self._slots[i]
+            if not bool(okh[i]):
+                # non-finite logits for THIS row: fail the one request
+                # typed (NumericAnomaly crosses the replica wire with its
+                # postmortem intact) instead of streaming garbage tokens;
+                # the slot's blocks go back to the pool and the other
+                # rows of the batch are untouched
+                from ..runtime.guardian import NumericAnomaly
+                err = NumericAnomaly.for_trip(
+                    step=s.pos, blame="unknown",
+                    flags={"decode_logits_nonfinite": True},
+                    detail="serve decode produced non-finite logits")
+                self.metrics.inc("numeric_anomalies")
+                if s.resp._fail(err):
+                    self.metrics.inc("failed")
+                telemetry.emit("anomaly_trip", tier="serve", slot=i,
+                               pos=s.pos, request_id=id(s.req))
+                if self.paged:
+                    self._release_request(s.req, s.blocks)
+                    self._tables[i, :] = 0
+                self._slots[i] = None
+                retired = True
+                continue
             tok = int(nxt[i])
             s.generated.append(tok)
             s.pos += 1

@@ -73,7 +73,12 @@ class ServeMetrics:
                  # chunked long-prompt prefill (serve/engine.py): one
                  # increment per decode_chunk_paged call a streaming
                  # prefill cursor advances (whole-prompt prefills count 1)
-                 "prefill_chunks")
+                 "prefill_chunks",
+                 # numeric guard (runtime/guardian.py): decode steps
+                 # whose logits came back non-finite for a slot — that
+                 # request fails typed (NumericAnomaly) and also counts
+                 # under "failed"
+                 "numeric_anomalies")
 
     # pool/HBM fields are GAUGES (live values, not monotone counters);
     # telemetry/registry.py keys its Prometheus type choice off this set

@@ -92,6 +92,11 @@ EVENT_KINDS = frozenset({
     # supervision / retry layers (runtime/watchdog.py, runtime/elastic.py)
     "watchdog_transition", "elastic_attempt", "elastic_failure",
     "elastic_preempt_resume", "elastic_shrink", "elastic_grow",
+    # numeric anomaly guardian (runtime/guardian.py): a tripped in-step
+    # guard (train tier) or non-finite decode logits (serve tier); a
+    # blamed data window entering the quarantine ledger; an ElasticRunner
+    # resume that rewinds to the last verified checkpoint
+    "anomaly_trip", "quarantine", "rewind",
     # live resize (runtime/elastic.py resize_in_memory /
     # core/trainer.py resize_in_memory): the between-attempt in-memory
     # resharding window — old/new world size, redistribution bytes

@@ -59,6 +59,30 @@ Faults fire BEFORE the dispatched fn runs, counting every dispatch
 pick explicit steps when that matters.  Parse errors raise driver-side
 (``parse_chaos``) and ship home as a ``RemoteError`` worker-side rather
 than silently dropping the fault.
+
+Numeric-layer faults (the anomaly guardian's test surface, honored at
+the train-step BUILD seams in ``core/trainer.py`` rather than any
+dispatch loop)::
+
+    RLA_TPU_CHAOS=nanloss@rank0:step3,gradspike@rank1:step5
+    RLA_TPU_CHAOS=badbatch@step5,bitflip@rank1:step4
+
+- ``nanloss`` poisons the traced loss metric at global step K;
+- ``gradspike`` scales the (per-replica, when a stacked local-gradient
+  tree exists and ``rankN`` names a replica) gradients by 1e4 at step K;
+- ``badbatch`` NaN-poisons the HOST batch feeding global step K (rank-
+  less by nature — the same poisoned batch reaches every replica), so
+  the guardian's blame cascade lands on ``data``;
+- ``bitflip`` flips one exponent bit of one element in the first
+  gradient leaf (replica ``rankN``'s row when stacked) — the silent-
+  data-corruption emulation whose per-rank divergence the guardian
+  names.
+
+Steps are the 1-based GLOBAL optimizer step.  Numeric faults are
+once-by-construction: they are claimed at step-BUILD time through the
+``RLA_TPU_CHAOS_NS`` token store, so the recompile after a guardian
+rewind replays the window CLEAN (without a namespace dir every build
+re-arms them — single-fit unit tests need no namespace).
 """
 
 from __future__ import annotations
@@ -74,13 +98,18 @@ CHAOS_ENV = "RLA_TPU_CHAOS"
 CHAOS_NS_ENV = "RLA_TPU_CHAOS_NS"
 CHAOS_EXIT_CODE = 43
 LOST_EXIT_CODE = 44
-_KINDS = ("crash", "hang", "slow", "preempt", "lost", "rejoin")
+_KINDS = ("crash", "hang", "slow", "preempt", "lost", "rejoin",
+          "nanloss", "gradspike", "badbatch", "bitflip")
 # faults that make sense at the replica serve-chunk layer: a replica is
 # a full process, so preempt/lost stay worker-layer kinds
 _REPLICA_KINDS = ("crash", "hang", "slow")
+# numeric faults (anomaly-guardian test surface): honored at the
+# train-step build seams in core/trainer.py, never by a dispatch loop
+_NUMERIC_KINDS = ("nanloss", "gradspike", "badbatch", "bitflip")
 
 LAYER_WORKER = "worker"
 LAYER_REPLICA = "replica"
+LAYER_NUMERIC = "numeric"
 
 
 def _lost_markers(rank: int, ns_dir: Optional[str]) -> List[str]:
@@ -166,8 +195,22 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
                 f"{_KINDS}")
         bits = target_q.split(":")
         target = bits[0]
-        layer = LAYER_WORKER
+        layer = LAYER_NUMERIC if kind in _NUMERIC_KINDS else LAYER_WORKER
         stage: Optional[int] = None
+        if kind == "badbatch" and target.startswith("step") \
+                and target[4:].isdigit():
+            # badbatch@stepK shorthand: the poisoned batch is global by
+            # nature (every replica consumes it), so there is no rank
+            if bits[1:]:
+                raise ValueError(
+                    f"chaos fault {part!r}: badbatch@stepK takes no "
+                    "qualifiers")
+            if int(target[4:]) < 1:
+                raise ValueError(
+                    f"chaos fault {part!r}: steps are 1-based")
+            faults.append(ChaosFault("badbatch", None, int(target[4:]),
+                                     layer=LAYER_NUMERIC))
+            continue
         if target == "all":
             rank = None
         elif target.startswith("stage") and target[5:].isdigit():
@@ -229,6 +272,16 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
         if kind != "slow" and delay is not None:
             raise ValueError(
                 f"chaos fault {part!r}: only 'slow' takes a delay")
+        if kind == "badbatch" and rank is not None:
+            raise ValueError(
+                f"chaos fault {part!r}: badbatch is rank-less (the "
+                "poisoned batch reaches every replica) — use "
+                "'badbatch@stepK' or 'badbatch@all:stepK'")
+        if kind in _NUMERIC_KINDS and stage is not None:
+            raise ValueError(
+                f"chaos fault {part!r}: numeric faults target 'rankN' "
+                "or 'all' (the SPMD step builders), not a pipeline "
+                "stage group")
         faults.append(ChaosFault(kind, rank, step, delay, once,
                                  layer=layer, stage=stage))
     return faults
@@ -361,3 +414,128 @@ class ChaosInjector:
                     self.freeze_heartbeat()
                 while True:  # wedged until the watchdog reaps us
                     time.sleep(3600)
+
+
+# --------------------------------------------------------------------- #
+# Numeric layer (anomaly-guardian faults, core/trainer.py build seams)   #
+# --------------------------------------------------------------------- #
+def numeric_faults() -> tuple:
+    """Numeric-layer faults of the ambient ``RLA_TPU_CHAOS`` spec (empty
+    tuple when unset — the zero-cost common case the trainer checks)."""
+    spec = knobs.get_str(CHAOS_ENV, "")
+    if not spec:
+        return ()
+    return tuple(f for f in parse_chaos(spec)
+                 if f.layer == LAYER_NUMERIC)
+
+
+def claim_numeric(fault: ChaosFault, rank: int = 0) -> bool:
+    """Claim a numeric fault at step-BUILD time.  With a chaos namespace
+    configured the claim is an atomic cross-process/cross-restart token
+    (O_CREAT|O_EXCL), so the recompile after a guardian rewind builds a
+    CLEAN step; without one every build re-arms the fault (single-fit
+    unit tests that never rewind)."""
+    ns_dir = knobs.get_raw(CHAOS_NS_ENV) or None
+    if not ns_dir:
+        return True
+    os.makedirs(ns_dir, exist_ok=True)
+    path = os.path.join(ns_dir, "numeric-" + fault.token(rank))
+    try:
+        os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+        return True
+    except FileExistsError:
+        return False
+
+
+def poison_batch(batch):
+    """``badbatch``'s host-side poison: NaN into the first element of
+    every float leaf (copies — the loader's arrays stay clean).  Int-only
+    batches pass through untouched (nothing to poison)."""
+    import numpy as np
+
+    def rec(x):
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            return tuple(rec(v) for v in x)
+        if isinstance(x, list):
+            return [rec(v) for v in x]
+        arr = np.asarray(x)
+        if arr.dtype.kind == "f" and arr.size:
+            arr = np.array(arr, copy=True)
+            arr.reshape(-1)[0] = np.nan
+            return arr
+        return x
+
+    return rec(batch)
+
+
+def apply_traced_numeric(fault: ChaosFault, step, metrics, grads=None,
+                         stacked=None):
+    """Apply one TRACED numeric fault inside a jitted train step.
+
+    ``step`` is the 0-based ``TrainState.step`` scalar (the fault's
+    ``stepN`` is the 1-based global step about to complete); ``grads``
+    is a global-view gradient tree, ``stacked`` a per-replica
+    ``[n_replicas, ...]`` local-gradient tree (compressed paths) —
+    whichever the calling builder has.  Everything is ``jnp.where``
+    math on the traced values: injecting a fault never changes program
+    structure, so the compile-guard retrace pins hold under chaos too.
+    Returns ``(metrics, grads, stacked)`` with the transforms applied.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    gate = jnp.asarray(step) == ((fault.step or 1) - 1)
+    if fault.kind == "nanloss":
+        loss = metrics.get("train_loss")
+        if loss is not None:
+            metrics = dict(metrics)
+            metrics["train_loss"] = jnp.where(
+                gate, jnp.asarray(jnp.nan, jnp.asarray(loss).dtype), loss)
+        return metrics, grads, stacked
+
+    tgt = stacked if stacked is not None else grads
+    if tgt is None:
+        return metrics, grads, stacked
+    leaves, treedef = jax.tree.flatten(tgt)
+    if not leaves:
+        return metrics, grads, stacked
+
+    if fault.kind == "gradspike":
+        spike = jnp.where(gate, jnp.float32(1e4), jnp.float32(1.0))
+
+        def sc(g):
+            s = spike
+            if stacked is not None and fault.rank is not None:
+                # scale only the targeted replica's row
+                row = jnp.arange(g.shape[0]) == fault.rank
+                s = jnp.where(row, spike, 1.0).reshape(
+                    (-1,) + (1,) * (g.ndim - 1))
+            return (g.astype(jnp.float32) * s).astype(g.dtype)
+
+        leaves = [sc(g) for g in leaves]
+    elif fault.kind == "bitflip":
+        # one exponent bit (1 << 27: +16 on the biased exponent, so the
+        # value blows up by 2**16 — survives a bf16 round-trip) of one
+        # element of the FIRST leaf; replica `rank`'s row when stacked
+        g = leaves[0]
+        f32 = g.astype(jnp.float32)
+        bits = jax.lax.bitcast_convert_type(f32, jnp.uint32).reshape(-1)
+        idx = 0
+        if stacked is not None and fault.rank is not None and g.ndim > 0:
+            per_row = 1
+            for d in g.shape[1:]:
+                per_row *= int(d)
+            idx = min(fault.rank, g.shape[0] - 1) * per_row
+        flipped = bits.at[idx].set(bits[idx] ^ jnp.uint32(1 << 27))
+        out = jax.lax.bitcast_convert_type(
+            jnp.where(gate, flipped, bits).reshape(f32.shape), jnp.float32)
+        leaves = [out.astype(g.dtype)] + leaves[1:]
+    else:  # badbatch is a HOST fault; nothing to do in-trace
+        return metrics, grads, stacked
+
+    tgt = jax.tree.unflatten(treedef, leaves)
+    if stacked is not None:
+        return metrics, grads, tgt
+    return metrics, tgt, stacked

@@ -146,13 +146,18 @@ def _thread_leak_guard(request):
 @pytest.fixture(autouse=True)
 def _chaos_leak_guard(request):
     """``RLA_TPU_CHAOS`` makes every spawned worker crash/hang/stall on
-    purpose (now including ``preempt@...``/``lost@...`` faults): ambient
-    in the driver env it would poison EVERY fan-out in the suite.  Only
+    purpose (now including ``preempt@...``/``lost@...`` faults and the
+    numeric layer — ``nanloss``/``gradspike``/``badbatch``/``bitflip`` —
+    which corrupts training numerics in-step): ambient in the driver env
+    it would poison EVERY fan-out in the suite.  Only
     ``@pytest.mark.chaos`` (or ``@pytest.mark.preempt``, whose tests
     drive the preemption/lost-host kinds) tests may see it set, and no
     test may leave it behind.  ``RLA_TPU_PREEMPT_GRACE_S`` gets the same
     treatment: left ambient it would install SIGTERM notice handlers in
-    every spawned worker of unrelated tests."""
+    every spawned worker of unrelated tests; so does
+    ``RLA_TPU_CHAOS_NS`` (the once-only claim namespace) — left behind,
+    a later chaos test would silently inherit spent claim tokens and
+    never fire its faults."""
     allowed = (request.node.get_closest_marker("chaos") is not None
                or request.node.get_closest_marker("preempt") is not None
                or request.node.get_closest_marker("pipeline_mpmd")
@@ -173,3 +178,6 @@ def _chaos_leak_guard(request):
     assert "RLA_TPU_PREEMPT_GRACE_S" not in os.environ, (
         f"{request.node.nodeid} left RLA_TPU_PREEMPT_GRACE_S set in the "
         "driver env; later fan-outs would install preemption handlers")
+    assert "RLA_TPU_CHAOS_NS" not in os.environ, (
+        f"{request.node.nodeid} left RLA_TPU_CHAOS_NS set in the driver "
+        "env; later chaos tests would inherit its spent claim tokens")

@@ -261,9 +261,11 @@ def test_pool_env_and_health_over_agents(two_agents):
 def _distributed_psum_agent(process_id):
     import jax
     import jax.numpy as jnp
+    from ray_lightning_accelerators_tpu.parallel.sharding import (
+        shard_map_compat)
 
     assert jax.process_count() == 2
-    out = jax.shard_map(
+    out = shard_map_compat(
         lambda x: jax.lax.psum(x, "i"),
         mesh=jax.sharding.Mesh(jax.devices(), ("i",)),
         in_specs=jax.sharding.PartitionSpec("i"),
@@ -450,7 +452,7 @@ def test_driver_mode_fit_through_agents(two_agents, tmp_path):
     x = np.random.default_rng(0).normal(size=(64, 32)).astype("float32")
     model = BoringModel()
     assert model.params is None
-    trainer = Trainer(max_epochs=2, precision="f32", seed=0,
+    trainer = Trainer(max_epochs=4, precision="f32", seed=0,
                       enable_checkpointing=False,
                       accelerator=HorovodRayAccelerator(
                           num_hosts=2, num_slots=2, agents=two_agents),
@@ -458,8 +460,8 @@ def test_driver_mode_fit_through_agents(two_agents, tmp_path):
     trainer.fit(model, DataLoader(ArrayDataset(x), batch_size=8))
 
     # rank-0 state re-hydrated into the driver's objects
-    assert trainer.global_step == 8  # 64 / 2 procs / batch 8 x 2 epochs
-    assert trainer.epochs_completed == 2
+    assert trainer.global_step == 16  # 64 / 2 procs / batch 8 x 4 epochs
+    assert trainer.epochs_completed == 4
     assert "loss" in trainer.callback_metrics
     assert model.params is not None
     # weights really trained: loss at re-hydrated params beats init,

@@ -337,6 +337,14 @@ def test_rebuild_remote_types():
     assert isinstance(back, WorkerWedged) and back.rank == 3
     back = rebuild_remote("ElasticResizeError", "bad size", "tb")
     assert isinstance(back, ElasticResizeError)
+    from ray_lightning_accelerators_tpu.runtime.guardian import (
+        NumericAnomaly)
+    a = NumericAnomaly.for_trip(step=9, blame="data", epoch=0, batch_idx=9,
+                                flags={"loss_nonfinite": True})
+    back = rebuild_remote("NumericAnomaly", str(a), "tb")
+    assert isinstance(back, NumericAnomaly)
+    assert back.step == 9 and back.blame == "data" and back.batch_idx == 9
+    assert back.diagnosis["flags"] == {"loss_nonfinite": True}
     back = rebuild_remote("SomeRandomError", "boom", "tb")
     assert isinstance(back, RemoteError)
 

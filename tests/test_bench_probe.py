@@ -142,13 +142,17 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         bench, "bench_long_context",
         lambda: {"metric": "long_context_cadence_ratio",
                  "value": 2.6, "unit": "ratio", "vs_baseline": 2.6})
+    monkeypatch.setattr(
+        bench, "bench_anomaly_guard",
+        lambda: {"metric": "anomaly_guard_overhead_ratio",
+                 "value": 1.01, "unit": "ratio", "vs_baseline": 1.01})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0  # real metric lines landed
     assert not ran
     lines = [json.loads(ln) for ln
              in capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 13
+    assert len(lines) == 14
     assert lines[0]["metric"] == "backend_probe"
     assert lines[0]["error"] == "backend unavailable"
     assert lines[1]["metric"] == "gradexchange_int8_wire_bytes_reduction"
@@ -163,6 +167,7 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     assert lines[10]["metric"] == "pipeline_bubble_accuracy"
     assert lines[11]["metric"] == "prefix_affinity_ttft_ratio"
     assert lines[12]["metric"] == "long_context_cadence_ratio"
+    assert lines[13]["metric"] == "anomaly_guard_overhead_ratio"
     assert all("error" not in r for r in lines[1:])
 
     # one fallback crashing must not take the others (or exit 0) down
@@ -184,7 +189,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
         "resize_inmem_vs_ckpt_downtime_ratio",
         "pipeline_bubble_accuracy",
         "prefix_affinity_ttft_ratio",
-        "long_context_cadence_ratio"]
+        "long_context_cadence_ratio",
+        "anomaly_guard_overhead_ratio"]
 
     # EVERY fallback crashed: death record survives, and rc=2 keeps
     # meaning "this window produced zero real numbers"
@@ -209,6 +215,8 @@ def test_dead_backend_emits_death_record_then_cpu_fallback(monkeypatch,
     monkeypatch.setattr(bench, "bench_prefix_affinity",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     monkeypatch.setattr(bench, "bench_long_context",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_anomaly_guard",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(SystemExit) as e3:
         bench.main()
@@ -281,6 +289,10 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         bench, "bench_long_context",
         lambda: {"metric": "long_context_cadence_ratio",
                  "value": 2.6, "unit": "ratio", "vs_baseline": 2.6})
+    monkeypatch.setattr(
+        bench, "bench_anomaly_guard",
+        lambda: {"metric": "anomaly_guard_overhead_ratio",
+                 "value": 1.01, "unit": "ratio", "vs_baseline": 1.01})
     with pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 0
@@ -302,7 +314,8 @@ def test_backend_death_mid_run_stops_remaining_benches(monkeypatch,
         "resize_inmem_vs_ckpt_downtime_ratio",
         "pipeline_bubble_accuracy",
         "prefix_affinity_ttft_ratio",
-        "long_context_cadence_ratio"]
+        "long_context_cadence_ratio",
+        "anomaly_guard_overhead_ratio"]
 
     # an EARLIER genuinely-failed bench keeps the window at exit 1
     # (death + fallbacks must not mask it)
@@ -431,6 +444,10 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
         bench, "bench_long_context",
         lambda: {"metric": "long_context_cadence_ratio",
                  "value": 2.6, "unit": "ratio", "vs_baseline": 2.6})
+    monkeypatch.setattr(
+        bench, "bench_anomaly_guard",
+        lambda: {"metric": "anomaly_guard_overhead_ratio",
+                 "value": 1.01, "unit": "ratio", "vs_baseline": 1.01})
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--benches", "selftest-dead,selftest",
                          "--probe-timeout", "5"])
@@ -451,6 +468,7 @@ def test_isolated_mode_death_still_emits_cpu_fallback(monkeypatch,
     assert "resize_inmem_vs_ckpt_downtime_ratio" in metrics
     assert "prefix_affinity_ttft_ratio" in metrics
     assert "long_context_cadence_ratio" in metrics
+    assert "anomaly_guard_overhead_ratio" in metrics
     assert any(r.get("error") == "backend died mid-run" for r in lines)
     assert "selftest" not in metrics  # nothing ran after the death
 

@@ -146,9 +146,11 @@ def _distributed_psum(process_id, coord, nprocs):
                       cpu_devices_per_process=1)
     import jax
     import jax.numpy as jnp
+    from ray_lightning_accelerators_tpu.parallel.sharding import (
+        shard_map_compat)
 
     assert jax.process_count() == nprocs
-    out = jax.shard_map(
+    out = shard_map_compat(
         lambda x: jax.lax.psum(x, "i"),
         mesh=jax.sharding.Mesh(jax.devices(), ("i",)),
         in_specs=jax.sharding.PartitionSpec("i"),
